@@ -1,0 +1,25 @@
+"""TPU-native framework for distributed and decentralized stochastic optimization.
+
+Built from scratch in JAX/XLA with the capability surface of
+``scavenx/distributed-optimization`` (see SURVEY.md): worker abstraction, graph
+topologies + Metropolis-Hastings mixing matrices, centralized and decentralized
+optimization algorithms (SGD, D-SGD/DGD, gradient tracking, EXTRA, decentralized
+ADMM), convex objective library, synthetic non-IID data generation, reference
+optimum computation, and suboptimality / consensus-error / communication-cost
+metrics — re-architected TPU-first:
+
+- each *worker* is a shard on a ``jax.sharding.Mesh`` (``[N, d]`` model array,
+  ``[N, n_local, d]`` stacked data), not a Python object;
+- one training iteration is a pure jitted function and a whole run is a single
+  ``jax.lax.scan``;
+- the gossip/mixing step compiles to real XLA collectives
+  (``jax.lax.ppermute`` for ring/torus neighbor exchange, ``psum`` for
+  fully-connected / centralized all-reduce) over ICI, instead of the
+  reference's simulated dense ``W @ models`` matmul (reference
+  ``trainer.py:173``);
+- a numpy backend is retained as the fidelity oracle.
+"""
+
+__version__ = "0.1.0"
+
+from distributed_optimization_tpu.config import ExperimentConfig  # noqa: F401

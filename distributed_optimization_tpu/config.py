@@ -1,0 +1,103 @@
+"""Typed experiment configuration.
+
+The reference keeps configuration as module-level constants assembled into a
+plain dict (reference ``main.py:6-38``) threaded through every layer. Here the
+same keys become a frozen dataclass with validation, plus new framework knobs
+(backend selection, algorithm, topology, mesh shape, eval cadence) that the
+reference does not have. ``to_dict``/``from_dict`` keep the reference's key
+names so configs round-trip with the reference's experiment setup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+# Algorithms the framework implements. The reference only has 'centralized'
+# (reference trainer.py:7-74) and 'dsgd' (trainer.py:76-197); the rest are the
+# planned capability extensions named in BASELINE.json.
+ALGORITHMS = ("centralized", "dsgd", "gradient_tracking", "extra", "admm")
+
+TOPOLOGIES = ("ring", "grid", "fully_connected", "erdos_renyi", "chain", "star")
+
+PROBLEM_TYPES = ("logistic", "quadratic")
+
+BACKENDS = ("jax", "numpy")
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """All hyperparameters for one experiment.
+
+    Field names match the reference's config-dict keys (reference
+    ``main.py:25-38``) where a counterpart exists.
+    """
+
+    # --- reference-parity fields (main.py:6-21 defaults) ---
+    n_workers: int = 25
+    local_batch_size: int = 16
+    n_iterations: int = 10_000
+    learning_rate_eta0: float = 0.05
+    l2_regularization_lambda: float = 1e-4
+    strong_convexity_mu: float = 1e-4
+    problem_type: str = "quadratic"
+    n_samples: int = 12_500
+    n_features: int = 80
+    n_informative_features: int = 50
+    classification_sep: float = 0.7
+    suboptimality_threshold: float = 0.08
+
+    # --- new framework knobs (no reference counterpart) ---
+    backend: str = "jax"  # 'jax' (TPU/XLA north star) | 'numpy' (fidelity oracle)
+    algorithm: str = "dsgd"
+    topology: str = "ring"
+    seed: int = 203  # reference seeds np.random.seed(203) at main.py:24
+    eval_every: int = 1  # full-data objective eval cadence (reference: every iter)
+    erdos_renyi_p: float = 0.4  # edge probability for the ER topology
+    mixing_impl: str = "auto"  # 'auto' | 'dense' | 'stencil' | 'shard_map'
+    dtype: str = "float32"
+    matmul_precision: str = "highest"  # jax.lax Precision for parity-sensitive math
+    record_consensus: bool = True
+
+    def __post_init__(self) -> None:
+        if self.problem_type not in PROBLEM_TYPES:
+            raise ValueError(f"Unknown problem type: {self.problem_type}")
+        if self.algorithm not in ALGORITHMS:
+            raise ValueError(f"Unknown algorithm: {self.algorithm}")
+        if self.topology not in TOPOLOGIES:
+            raise ValueError(f"Unknown topology: {self.topology}")
+        if self.backend not in BACKENDS:
+            raise ValueError(f"Unknown backend: {self.backend}")
+        if self.mixing_impl not in ("auto", "dense", "stencil", "shard_map"):
+            raise ValueError(f"Unknown mixing impl: {self.mixing_impl}")
+        if self.n_workers <= 0:
+            raise ValueError("n_workers must be positive")
+        if self.topology == "grid":
+            side = int(math.isqrt(self.n_workers))
+            if side * side != self.n_workers:
+                raise ValueError(
+                    f"grid topology requires a perfect-square worker count, got {self.n_workers}"
+                )
+
+    # The regularizer actually used for the gradient/objective: the reference
+    # uses lambda for logistic and mu (== lambda by default) for quadratic
+    # (reference worker.py:36-42, main.py:20-21).
+    @property
+    def reg_param(self) -> float:
+        return (
+            self.l2_regularization_lambda
+            if self.problem_type == "logistic"
+            else self.strong_convexity_mu
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ExperimentConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def replace(self, **kwargs: Any) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kwargs)

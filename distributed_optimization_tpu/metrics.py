@@ -1,0 +1,133 @@
+"""Metrics: suboptimality, consensus error, comms cost, iterations-to-threshold.
+
+These four metrics ARE the product of the reference study (SURVEY.md §5.5) and
+are reproduced bit-comparably in definition:
+
+- suboptimality gap  f(x̄_t) − f(x*)  on the FULL dataset every recorded
+  iteration (reference ``trainer.py:66-69,188-191``);
+- consensus error  (1/N) Σ_i ‖x_i − x̄‖²  (reference ``trainer.py:184-186``);
+- total floats transmitted — an *analytic* cost model, kept even though the
+  TPU backend performs real collectives, so numbers stay comparable with the
+  reference's Tables I/II (closed forms below);
+- iterations to reach a suboptimality threshold (reference
+  ``simulator.py:73-79``).
+
+On the TPU path the per-iteration values accumulate on device inside the
+``lax.scan`` carry/ys and are fetched once per run — no per-iteration host
+syncs (the reference pays a full-dataset numpy objective evaluation on the
+host every iteration, ``trainer.py:67``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from distributed_optimization_tpu.parallel.topology import Topology
+
+# Gossip rounds per iteration for each decentralized algorithm: gradient
+# tracking mixes both the model and the tracker array each iteration
+# (2 rounds); D-SGD / EXTRA / ADMM exchange one model-sized vector per
+# neighbor per iteration.
+GOSSIP_ROUNDS_PER_ITER = {"dsgd": 1, "extra": 1, "gradient_tracking": 2, "admm": 1}
+
+
+@dataclasses.dataclass
+class RunHistory:
+    """Per-iteration history of one training run (host numpy arrays)."""
+
+    objective: np.ndarray  # suboptimality gap f(x̄_t) − f(x*), [T_recorded]
+    consensus_error: Optional[np.ndarray]  # [T_recorded] or None (centralized)
+    time: np.ndarray  # wall-clock seconds since run start, [T_recorded]
+    eval_iterations: np.ndarray  # iteration numbers (1-based) the rows refer to
+    total_floats_transmitted: float
+    iters_per_second: float = float("nan")
+
+    def as_dict(self) -> dict:
+        out = {
+            "objective": self.objective.tolist(),
+            "time": self.time.tolist(),
+        }
+        if self.consensus_error is not None:
+            out["consensus_error"] = self.consensus_error.tolist()
+        return out
+
+
+def consensus_error(models: np.ndarray) -> float:
+    """(1/N) Σ_i ‖x_i − x̄‖² for an [N, d] model stack."""
+    mean = models.mean(axis=0)
+    return float(np.mean(np.sum((models - mean) ** 2, axis=1)))
+
+
+def iterations_to_threshold(objective_history: np.ndarray, threshold: float,
+                            eval_iterations: Optional[np.ndarray] = None) -> int:
+    """First (1-based) iteration whose suboptimality gap <= threshold, or -1.
+
+    Parity: reference ``simulator.py:73-79``. ``eval_iterations`` maps row
+    index -> iteration number when eval_every > 1.
+    """
+    if objective_history.size == 0:
+        return -1
+    below = np.nonzero(objective_history <= threshold)[0]
+    if below.size == 0:
+        return -1
+    first = int(below[0])
+    if eval_iterations is not None:
+        return int(eval_iterations[first])
+    return first + 1
+
+
+def centralized_floats_per_iteration(n_workers: int, n_features: int) -> float:
+    """2·N·d floats/iter: N gradient uploads + N model broadcasts.
+
+    Parity: reference ``trainer.py:44-61``. Closed form over T iterations is
+    2NdT = 4.05e7 for the report config (BASELINE.md).
+    """
+    return 2.0 * n_workers * n_features
+
+
+def decentralized_floats_per_iteration(
+    topo: Topology, n_features: int, algorithm: str = "dsgd"
+) -> float:
+    """Σ_i deg_i · d floats per gossip round, times rounds for the algorithm.
+
+    Parity: reference ``trainer.py:169-170``. Closed form ΣdegᵢdT gives
+    4.05e7 (ring) / 8.1e7 (grid) / 4.86e8 (fc) for the report config.
+    """
+    rounds = GOSSIP_ROUNDS_PER_ITER.get(algorithm, 1)
+    return topo.floats_per_iteration * n_features * rounds
+
+
+@dataclasses.dataclass
+class NumericalResult:
+    """One row of the experiment report (reference ``simulator.py:88-92``)."""
+
+    label: str
+    iterations_to_threshold: int  # -1 = never reached
+    total_transmission_floats: float
+    avg_worker_transmission_floats: float
+    spectral_gap: Optional[float] = None
+    iters_per_second: float = float("nan")
+
+
+def summarize_run(
+    label: str,
+    history: RunHistory,
+    threshold: float,
+    n_workers: int,
+    spectral_gap: Optional[float] = None,
+) -> NumericalResult:
+    iters = iterations_to_threshold(
+        history.objective, threshold, history.eval_iterations
+    )
+    total = history.total_floats_transmitted
+    return NumericalResult(
+        label=label,
+        iterations_to_threshold=iters,
+        total_transmission_floats=total,
+        avg_worker_transmission_floats=total / n_workers if n_workers else 0.0,
+        spectral_gap=spectral_gap,
+        iters_per_second=history.iters_per_second,
+    )

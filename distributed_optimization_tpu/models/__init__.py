@@ -1,0 +1,3 @@
+"""Problem/model definitions: objective families the framework can optimize."""
+
+from distributed_optimization_tpu.models.base import Problem, get_problem  # noqa: F401

@@ -1,0 +1,56 @@
+"""Problem abstraction: one convex objective family, as pure functions.
+
+The reference dispatches on a ``problem_type`` string in four separate places
+(reference ``worker.py:35-44``, ``trainer.py:21-28``, ``trainer.py:142-149``,
+``simulator.py:36``). Here the dispatch happens once: a :class:`Problem`
+bundles the jittable objective/gradient kernels and is threaded through the
+backends as a static argument, so XLA specializes the compiled step per
+problem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class Problem:
+    """A convex objective family f(w) = data_term(w; X, y) + (reg/2)‖w‖².
+
+    All callables are pure and jittable:
+
+    - ``objective(w, X, y, reg)`` — full/mini-batch mean objective
+      (reference parity: obj_problems.py:3-11, 39-44).
+    - ``gradient(w, X, y, reg)`` — mean gradient over the given rows
+      (reference parity: obj_problems.py:13-20, 46-53).
+    - ``objective_weighted(w, X, y, weights, reg)`` / ``gradient_weighted`` —
+      per-sample-weight forms used on the TPU path (static shapes; weights
+      encode masking / effective batch size).
+    """
+
+    name: str
+    objective: Callable[..., jax.Array]
+    gradient: Callable[..., jax.Array]
+    objective_weighted: Callable[..., jax.Array]
+    gradient_weighted: Callable[..., jax.Array]
+
+
+_REGISTRY: dict[str, Problem] = {}
+
+
+def register_problem(problem: Problem) -> Problem:
+    _REGISTRY[problem.name] = problem
+    return problem
+
+
+def get_problem(name: str) -> Problem:
+    """Look up a problem family by name ('logistic', 'quadratic', ...)."""
+    # Import here so registration happens on first use without import cycles.
+    from distributed_optimization_tpu.models import logistic, quadratic  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise ValueError(f"Unknown problem type: {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]

@@ -1,0 +1,3 @@
+"""Pure jittable math kernels: objectives, gradients, sampling, mixing."""
+
+from distributed_optimization_tpu.ops import losses, mixing, sampling  # noqa: F401

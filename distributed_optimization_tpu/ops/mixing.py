@@ -1,0 +1,147 @@
+"""Compiled gossip/mixing operators: x -> W x and neighbor sums x -> A x.
+
+The reference realizes gossip as a dense ``W @ models`` matmul in numpy
+(reference ``trainer.py:173``) — a *simulation* of communication. Here the
+same linear operator has three interchangeable compiled forms:
+
+- ``dense``: an on-device matmul with the [N, N] mixing matrix. Works for any
+  graph (Erdős–Rényi et al.). Under GSPMD sharding this becomes an
+  all-gather + local contraction — fine for irregular graphs.
+- ``stencil``: for ring / torus / fully-connected graphs, where MH weights are
+  uniform by symmetry, W x is a weighted sum of circular shifts of x along the
+  worker axis (ring: ±1; torus: ±1 along each grid axis; fc: the global mean).
+  When x is sharded over the mesh, XLA compiles ``jnp.roll`` on the sharded
+  axis into ``CollectivePermute`` over ICI and the fc mean into an
+  ``AllReduce`` — the communication graph maps onto the pod topology, which is
+  the north-star design (SURVEY.md §5.8).
+- ``shard_map``: explicit-collective form of the same stencils using
+  ``jax.lax.ppermute``/``psum`` (see ``parallel/collectives.py``), for when
+  manual control over the collective schedule is wanted.
+
+All three agree to floating-point tolerance; property tests check stencil and
+shard_map forms against the dense matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from distributed_optimization_tpu.parallel.topology import Topology
+
+MixFn = Callable[[jax.Array], jax.Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class MixingOp:
+    """Jittable linear operators attached to one topology.
+
+    ``apply``: x [N, ...] -> W x (the gossip averaging step).
+    ``neighbor_sum``: x [N, ...] -> A x (sum over graph neighbors; used by
+    ADMM-family algorithms whose updates need Σ_{j∈N(i)} x_j rather than the
+    doubly-stochastic average).
+    """
+
+    topology_name: str
+    impl: str
+    apply: MixFn
+    neighbor_sum: MixFn
+
+
+def _supports_stencil(topo: Topology) -> bool:
+    if topo.name == "fully_connected":
+        return True
+    if topo.name == "ring":
+        return topo.n >= 3
+    if topo.name == "grid":
+        return topo.grid_shape is not None and min(topo.grid_shape) >= 3
+    return False
+
+
+def make_mixing_op(topo: Topology, impl: str = "auto", dtype=jnp.float32) -> MixingOp:
+    """Build the compiled mixing operator for a topology.
+
+    ``impl``: 'auto' picks 'stencil' where the graph embeds into the mesh as
+    shifts (ring/grid/fc), else 'dense'. 'shard_map' variants are built in
+    ``parallel/collectives.py`` because they need a Mesh.
+    """
+    if impl == "auto":
+        impl = "stencil" if _supports_stencil(topo) else "dense"
+    if impl == "shard_map":
+        raise ValueError(
+            "shard_map mixing ops need a Mesh; build them via "
+            "distributed_optimization_tpu.parallel.collectives instead"
+        )
+    if impl not in ("dense", "stencil"):
+        raise ValueError(f"Unknown mixing impl: {impl!r}")
+    if impl == "stencil" and not _supports_stencil(topo):
+        raise ValueError(f"stencil mixing unsupported for {topo.name} (n={topo.n})")
+
+    if impl == "dense":
+        W = jnp.asarray(topo.mixing_matrix, dtype=dtype)
+        A = jnp.asarray(topo.adjacency, dtype=dtype)
+
+        def apply(x: jax.Array) -> jax.Array:
+            return jnp.tensordot(W, x, axes=1).astype(x.dtype)
+
+        def neighbor_sum(x: jax.Array) -> jax.Array:
+            return jnp.tensordot(A, x, axes=1).astype(x.dtype)
+
+        return MixingOp(topo.name, "dense", apply, neighbor_sum)
+
+    if topo.name == "fully_connected":
+        # Degree N-1 everywhere ⇒ every MH weight (incl. diagonal) is 1/N:
+        # mixing is exactly the global mean. Compiles to AllReduce when sharded.
+        def apply(x: jax.Array) -> jax.Array:
+            return jnp.broadcast_to(jnp.mean(x, axis=0, keepdims=True), x.shape).astype(
+                x.dtype
+            )
+
+        def neighbor_sum(x: jax.Array) -> jax.Array:
+            return (jnp.sum(x, axis=0, keepdims=True) - x).astype(x.dtype)
+
+        return MixingOp(topo.name, "stencil", apply, neighbor_sum)
+
+    if topo.name == "ring":
+        # Degree 2 everywhere ⇒ all weights (self and both neighbors) are 1/3.
+        w = 1.0 / 3.0
+
+        def apply(x: jax.Array) -> jax.Array:
+            return (w * (x + jnp.roll(x, 1, axis=0) + jnp.roll(x, -1, axis=0))).astype(
+                x.dtype
+            )
+
+        def neighbor_sum(x: jax.Array) -> jax.Array:
+            return (jnp.roll(x, 1, axis=0) + jnp.roll(x, -1, axis=0)).astype(x.dtype)
+
+        return MixingOp(topo.name, "stencil", apply, neighbor_sum)
+
+    if topo.name == "grid":
+        rows, cols = topo.grid_shape  # type: ignore[misc]
+        # Degree 4 everywhere ⇒ all five weights are 1/5. Worker i lives at
+        # grid position (i // cols, i % cols) — row-major, matching the
+        # reference's node indexing (trainer.py:104).
+        w = 1.0 / 5.0
+
+        def _shifts(x: jax.Array) -> jax.Array:
+            g = x.reshape(rows, cols, *x.shape[1:])
+            s = (
+                jnp.roll(g, 1, axis=0)
+                + jnp.roll(g, -1, axis=0)
+                + jnp.roll(g, 1, axis=1)
+                + jnp.roll(g, -1, axis=1)
+            )
+            return s.reshape(x.shape)
+
+        def apply(x: jax.Array) -> jax.Array:
+            return (w * (x + _shifts(x))).astype(x.dtype)
+
+        def neighbor_sum(x: jax.Array) -> jax.Array:
+            return _shifts(x).astype(x.dtype)
+
+        return MixingOp(topo.name, "stencil", apply, neighbor_sum)
+
+    raise ValueError(f"No stencil form for topology {topo.name!r}")

@@ -1,0 +1,78 @@
+"""Per-worker mini-batch sampling with explicit JAX PRNG keys.
+
+The reference samples each worker's batch from one *global* numpy RNG stream
+(``np.random.choice`` at reference ``worker.py:27``, seeded once at
+``main.py:24``), which makes batch draws order-dependent across workers. The
+TPU-native design replaces that with counter-based PRNG: every (worker,
+iteration) pair gets its own key via ``fold_in``, so sampling is
+order-independent, reproducible, and embarrassingly parallel across the mesh.
+Exact batch-sequence parity with the reference is impossible by construction
+(documented in SURVEY.md §3.4); equivalence tests inject identical batches
+instead.
+
+Semantics preserved from the reference (``worker.py:15-28``):
+- sampling is without replacement;
+- the effective batch size is ``min(batch_size, n_valid)`` — encoded as a
+  weight vector rather than a dynamic shape;
+- a worker with zero valid samples yields an all-zero weight vector (its
+  gradient contribution is then exactly the regularizer term, mirroring the
+  empty-batch guard at ``obj_problems.py:14-15``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_batch_indices(
+    key: jax.Array, n_local: int, n_valid: jax.Array, batch_size: int
+) -> tuple[jax.Array, jax.Array]:
+    """Draw ``batch_size`` row indices without replacement from the valid rows.
+
+    Returns ``(indices [batch_size] int32, weights [batch_size] f32)`` where
+    weights are ``1/min(batch_size, n_valid)`` on rows that represent real
+    draws and 0 on padding rows. Uses the Gumbel-top-k trick (uniform scores +
+    top-k) so shapes stay static under jit.
+    """
+    scores = jax.random.uniform(key, (n_local,))
+    # Push invalid (padding) rows to the bottom of the ranking.
+    valid = jnp.arange(n_local) < n_valid
+    scores = jnp.where(valid, scores, -jnp.inf)
+    # A shard can be smaller than the requested batch (reference worker.py:21
+    # clamps the effective batch); keep static shapes by tiling the top-k
+    # indices up to batch_size and zero-weighting the surplus rows.
+    k = min(batch_size, n_local)
+    _, top_indices = jax.lax.top_k(scores, k)
+    indices = jnp.resize(top_indices, (batch_size,))
+    effective = jnp.minimum(jnp.minimum(batch_size, n_valid), n_local)
+    draw_is_real = jnp.arange(batch_size) < effective
+    weights = jnp.where(draw_is_real, 1.0 / jnp.maximum(effective, 1), 0.0)
+    return indices.astype(jnp.int32), weights.astype(jnp.float32)
+
+
+def sample_worker_batches(
+    key: jax.Array,
+    step: jax.Array,
+    X: jax.Array,  # [N, L, d] stacked per-worker shards (padded)
+    y: jax.Array,  # [N, L]
+    n_valid: jax.Array,  # [N] true shard sizes
+    batch_size: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sample one mini-batch per worker for iteration ``step``.
+
+    Returns ``(Xb [N, b, d], yb [N, b], weights [N, b])``. Each worker's key is
+    ``fold_in(fold_in(key, step), worker_id)`` — independent of every other
+    worker and iteration.
+    """
+    n_workers = X.shape[0]
+    step_key = jax.random.fold_in(key, step)
+    worker_keys = jax.vmap(lambda i: jax.random.fold_in(step_key, i))(
+        jnp.arange(n_workers)
+    )
+
+    def one(worker_key, Xi, yi, ni):
+        idx, w = sample_batch_indices(worker_key, Xi.shape[0], ni, batch_size)
+        return Xi[idx], yi[idx], w
+
+    return jax.vmap(one)(worker_keys, X, y, n_valid)

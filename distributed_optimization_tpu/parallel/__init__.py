@@ -1,0 +1,3 @@
+"""Parallelism layer: topologies, device meshes, and collective mixing."""
+
+from distributed_optimization_tpu.parallel.topology import Topology, build_topology  # noqa: F401

@@ -1,0 +1,222 @@
+"""Communication-graph topologies and Metropolis-Hastings mixing matrices.
+
+Capability parity with the reference's topology + mixing-matrix builder
+(reference ``trainer.py:91-136``): ring, periodic 2-D grid (torus), and
+fully-connected graphs with Metropolis-Hastings gossip weights
+``W_ij = 1/(1 + max(deg_i, deg_j))`` and self-weight = row remainder, plus the
+same invariants (row-stochastic, symmetric) and the spectral gap ``1 - ρ``
+from the second-largest absolute eigenvalue.
+
+Extensions beyond the reference: Erdős–Rényi random graphs (the BASELINE.json
+decentralized-ADMM config), chain (path), and star topologies; and a
+*stencil* description (shift offsets + weights) for the topologies whose
+mixing step maps onto TPU ICI as `ppermute` neighbor shifts instead of a dense
+``W @ models`` matmul — ring/chain/torus are the cases where the communication
+graph embeds directly into the pod mesh.
+
+This module is host-side (numpy): topologies are built once per run, outside
+``jit``. The compiled mixing operators that consume them live in
+``ops/mixing.py`` and ``parallel/collectives.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """An undirected communication graph plus its gossip structure."""
+
+    name: str
+    n: int
+    adjacency: np.ndarray  # [N, N] 0/1, zero diagonal
+    degrees: np.ndarray  # [N]
+    mixing_matrix: np.ndarray  # [N, N] Metropolis-Hastings, row-stochastic, symmetric
+    grid_shape: Optional[tuple[int, int]] = None  # set for 'grid'
+
+    @property
+    def spectral_gap(self) -> float:
+        """1 - ρ where ρ is the second-largest |eigenvalue| of W.
+
+        Parity: reference trainer.py:133-135. Closed-form values for the
+        report setup: ring(25) ≈ 0.0209, 5x5 torus ≈ 0.2764, fc = 1.0.
+        """
+        if self.n < 2:
+            return 1.0
+        eigs = np.sort(np.abs(np.linalg.eigvalsh(self.mixing_matrix)))
+        return float(1.0 - eigs[-2])
+
+    @property
+    def floats_per_iteration(self) -> float:
+        """Analytic gossip cost in floats per iteration per model dimension.
+
+        One gossip round sends each worker's model to each of its neighbors:
+        Σ_i deg_i values per model coordinate (reference trainer.py:169-170).
+        Multiply by d (and by rounds-per-iteration for two-mix algorithms).
+        """
+        return float(np.sum(self.degrees))
+
+    def validate(self) -> None:
+        """Invariant checks (parity: reference trainer.py:128-131 asserts)."""
+        W = self.mixing_matrix
+        if not np.allclose(W.sum(axis=1), 1.0):
+            raise AssertionError(f"Mixing matrix rows must sum to 1 ({self.name})")
+        if not np.allclose(W, W.T):
+            raise AssertionError(f"Mixing matrix must be symmetric ({self.name})")
+        if np.any(W < -1e-12):
+            raise AssertionError(f"Mixing matrix must be nonnegative ({self.name})")
+
+
+def _ring_adjacency(n: int) -> np.ndarray:
+    adj = np.zeros((n, n))
+    ids = np.arange(n)
+    adj[ids, (ids + 1) % n] = 1.0
+    adj[ids, (ids - 1) % n] = 1.0
+    np.fill_diagonal(adj, 0.0)  # n == 1, 2 edge cases
+    return adj
+
+
+def _chain_adjacency(n: int) -> np.ndarray:
+    adj = np.zeros((n, n))
+    ids = np.arange(n - 1)
+    adj[ids, ids + 1] = 1.0
+    adj[ids + 1, ids] = 1.0
+    return adj
+
+
+def _star_adjacency(n: int) -> np.ndarray:
+    adj = np.zeros((n, n))
+    adj[0, 1:] = 1.0
+    adj[1:, 0] = 1.0
+    return adj
+
+
+def _torus_adjacency(rows: int, cols: int) -> np.ndarray:
+    """Periodic 2-D grid. Worker (r, c) sits at index r*cols + c (row-major),
+    matching the reference's sorted-node indexing of
+    ``networkx.grid_2d_graph(periodic=True)`` (reference trainer.py:103-108)."""
+    n = rows * cols
+    adj = np.zeros((n, n))
+    for r in range(rows):
+        for c in range(cols):
+            i = r * cols + c
+            for rr, cc in ((r - 1, c), (r + 1, c), (r, c - 1), (r, c + 1)):
+                j = (rr % rows) * cols + (cc % cols)
+                if j != i:  # degenerate 1- or 2-length axes collapse neighbors
+                    adj[i, j] = 1.0
+    return adj
+
+
+def _erdos_renyi_adjacency(n: int, p: float, seed: int) -> np.ndarray:
+    """Connected Erdős–Rényi G(n, p): resample until connected."""
+    rng = np.random.default_rng(seed)
+    for _ in range(1000):
+        upper = rng.random((n, n)) < p
+        adj = np.triu(upper, k=1).astype(float)
+        adj = adj + adj.T
+        if _is_connected(adj):
+            return adj
+    raise RuntimeError(f"Could not sample a connected G({n}, {p}) in 1000 tries")
+
+
+def _is_connected(adj: np.ndarray) -> bool:
+    n = adj.shape[0]
+    if n == 0:
+        return False
+    reached = np.zeros(n, dtype=bool)
+    frontier = [0]
+    reached[0] = True
+    while frontier:
+        i = frontier.pop()
+        for j in np.nonzero(adj[i])[0]:
+            if not reached[j]:
+                reached[j] = True
+                frontier.append(int(j))
+    return bool(reached.all())
+
+
+def metropolis_hastings_weights(adjacency: np.ndarray) -> np.ndarray:
+    """Metropolis-Hastings mixing matrix from an adjacency matrix.
+
+    W_ij = 1 / (1 + max(deg_i, deg_j)) for edges, W_ii = 1 - Σ_j W_ij.
+    Parity: reference trainer.py:118-126. Vectorized instead of the
+    reference's per-neighbor Python loops.
+    """
+    degrees = adjacency.sum(axis=1)
+    pairwise_max = np.maximum(degrees[:, None], degrees[None, :])
+    W = adjacency / (1.0 + pairwise_max)
+    np.fill_diagonal(W, 0.0)
+    np.fill_diagonal(W, 1.0 - W.sum(axis=1))
+    return W
+
+
+def build_topology(
+    name: str,
+    n: int,
+    *,
+    erdos_renyi_p: float = 0.4,
+    seed: int = 0,
+) -> Topology:
+    """Build a named topology over ``n`` workers, with MH mixing weights."""
+    grid_shape: Optional[tuple[int, int]] = None
+    if name == "ring":
+        adj = _ring_adjacency(n)
+    elif name == "grid":
+        side = int(math.isqrt(n))
+        if side * side != n:
+            # Parity: reference trainer.py:100-102 raises for non-square N.
+            raise ValueError(f"grid topology requires a perfect square, got {n}")
+        adj = _torus_adjacency(side, side)
+        grid_shape = (side, side)
+    elif name == "fully_connected":
+        adj = np.ones((n, n)) - np.eye(n)
+    elif name == "erdos_renyi":
+        adj = _erdos_renyi_adjacency(n, erdos_renyi_p, seed)
+    elif name == "chain":
+        adj = _chain_adjacency(n)
+    elif name == "star":
+        adj = _star_adjacency(n)
+    else:
+        raise ValueError(f"Unknown topology: {name!r}")
+
+    topo = Topology(
+        name=name,
+        n=n,
+        adjacency=adj,
+        degrees=adj.sum(axis=1),
+        mixing_matrix=metropolis_hastings_weights(adj),
+        grid_shape=grid_shape,
+    )
+    topo.validate()
+    return topo
+
+
+def ring_spectral_gap_closed_form(n: int) -> float:
+    """Closed-form spectral gap of the MH ring (all degrees 2 ⇒ W_ij = 1/3).
+
+    Eigenvalues of W are (1 + 2cos(2πk/n))/3; ρ = max_{k≠0} |λ_k|.
+    Matches the report's §III-A value 0.0209 for n = 25.
+    """
+    if n < 3:
+        return 1.0
+    lambdas = (1.0 + 2.0 * np.cos(2.0 * np.pi * np.arange(1, n) / n)) / 3.0
+    return float(1.0 - np.max(np.abs(lambdas)))
+
+
+def torus_spectral_gap_closed_form(side: int) -> float:
+    """Closed-form spectral gap of the MH torus (degree 4 ⇒ off-diag 1/5).
+
+    Eigenvalues are (1 + 2cos(2πj/s) + 2cos(2πk/s))/5 over j,k.
+    Matches the report's §III-A value 0.2764 for s = 5.
+    """
+    js = np.arange(side)
+    cj = 2.0 * np.cos(2.0 * np.pi * js / side)
+    lam = (1.0 + cj[:, None] + cj[None, :]) / 5.0
+    lam = lam.ravel()
+    lam_sorted = np.sort(np.abs(lam))
+    return float(1.0 - lam_sorted[-2])

@@ -1,0 +1,9 @@
+"""Host-side utilities: data generation, reference optimum, I/O helpers."""
+
+from distributed_optimization_tpu.utils.data import (  # noqa: F401
+    DeviceDataset,
+    HostDataset,
+    generate_synthetic_dataset,
+    stack_shards,
+)
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum  # noqa: F401

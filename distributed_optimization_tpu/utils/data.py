@@ -1,0 +1,128 @@
+"""Synthetic data generation and non-IID partitioning.
+
+Capability parity with the reference's data layer (reference ``utils.py:5-50``):
+sklearn ``make_classification`` / ``make_regression`` with identical
+hyperparameters, ``StandardScaler`` standardization, an appended all-ones bias
+column (d → d+1), and the *sorted-by-target* partition across workers that
+forces label/target heterogeneity (the non-IID knob, ``utils.py:34-38``).
+
+Generation stays host-side numpy on purpose: it is the parity anchor that
+makes convergence curves comparable across the numpy oracle backend, the JAX
+backend, and the reference's published numbers. The device side gets the data
+as *stacked, padded* arrays — ``X [N, L, d]``, ``y [N, L]``, per-worker valid
+counts — because N ragged shards would defeat XLA's static-shape compilation;
+padding rows carry zero weight everywhere downstream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class HostDataset:
+    """Full dataset + per-worker partition, host-side (numpy, float64)."""
+
+    X_full: np.ndarray  # [n_samples, d] standardized, bias column appended
+    y_full: np.ndarray  # [n_samples] (±1 for logistic)
+    shard_indices: list[np.ndarray]  # per-worker row indices into X_full
+    problem_type: str
+
+    @property
+    def n_features(self) -> int:
+        return self.X_full.shape[1]
+
+    @property
+    def n_workers(self) -> int:
+        return len(self.shard_indices)
+
+    def shard(self, i: int) -> tuple[np.ndarray, np.ndarray]:
+        idx = self.shard_indices[i]
+        return self.X_full[idx], self.y_full[idx]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceDataset:
+    """Stacked, padded per-worker shards ready for device placement.
+
+    ``X``: [N, L, d], ``y``: [N, L], ``n_valid``: [N] — L is the max shard
+    size; rows at index >= n_valid[i] are zero padding.
+    """
+
+    X: np.ndarray
+    y: np.ndarray
+    n_valid: np.ndarray
+
+    @property
+    def n_workers(self) -> int:
+        return self.X.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.X.shape[2]
+
+
+def generate_synthetic_dataset(config) -> HostDataset:
+    """Generate the study's synthetic dataset and its non-IID partition.
+
+    Mirrors reference ``utils.py:5-50``: same sklearn generators, same
+    hyperparameters (n_redundant = n_features - n_informative,
+    n_clusters_per_class=1, flip_y=0.05, random_state=203 by default via
+    ``config.seed``; noise=10.0 for regression), labels mapped to ±1,
+    StandardScaler, bias column, argsort(y) + array_split partition.
+    """
+    from sklearn.datasets import make_classification, make_regression
+    from sklearn.preprocessing import StandardScaler
+
+    if config.problem_type == "logistic":
+        X, y = make_classification(
+            n_samples=config.n_samples,
+            n_features=config.n_features,
+            n_informative=config.n_informative_features,
+            n_redundant=config.n_features - config.n_informative_features,
+            n_clusters_per_class=1,
+            flip_y=0.05,
+            class_sep=config.classification_sep,
+            random_state=config.seed,
+        )
+        y = y.astype(np.float64) * 2.0 - 1.0
+    elif config.problem_type == "quadratic":
+        X, y = make_regression(
+            n_samples=config.n_samples,
+            n_features=config.n_features,
+            n_informative=config.n_informative_features,
+            noise=10.0,
+            random_state=config.seed,
+        )
+        y = y.astype(np.float64)
+    else:
+        raise ValueError(f"Unknown problem type: {config.problem_type}")
+
+    X = StandardScaler().fit_transform(X)
+    X = np.hstack([X, np.ones((X.shape[0], 1))])  # bias column: d -> d+1
+
+    # Non-IID partition: sort by target, then split contiguously so each
+    # worker sees a narrow slice of the target distribution.
+    order = np.argsort(y)
+    shard_indices = [np.asarray(s) for s in np.array_split(order, config.n_workers)]
+
+    return HostDataset(
+        X_full=X, y_full=y, shard_indices=shard_indices, problem_type=config.problem_type
+    )
+
+
+def stack_shards(dataset: HostDataset, dtype=np.float32) -> DeviceDataset:
+    """Stack ragged shards into padded [N, L, d] arrays for the device path."""
+    n = dataset.n_workers
+    d = dataset.n_features
+    sizes = np.array([len(idx) for idx in dataset.shard_indices], dtype=np.int32)
+    L = int(sizes.max()) if n else 0
+    X = np.zeros((n, L, d), dtype=dtype)
+    y = np.zeros((n, L), dtype=dtype)
+    for i in range(n):
+        Xi, yi = dataset.shard(i)
+        X[i, : sizes[i]] = Xi
+        y[i, : sizes[i]] = yi
+    return DeviceDataset(X=X, y=y, n_valid=sizes)

@@ -1,0 +1,62 @@
+"""Reference-optimum oracle: f(x*) from sklearn's saga solvers.
+
+Capability parity with reference ``simulator.py:32-69``. The optimum stays a
+host-side sklearn computation on purpose — the suboptimality metric needs a
+ground truth that is independent of any backend under test.
+
+The load-bearing detail (SURVEY.md §3.5): the study's objective is
+*mean* loss + (λ/2)‖w‖², while sklearn penalizes *total* loss, so the sklearn
+regularization strength must be α = λ·n_samples (C = 1/α for logistic). The
+bias column is stripped before fitting and the intercept re-appended so the
+returned w* lives in the same (d+1)-dimensional space as the trained models.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_optimization_tpu.utils.data import HostDataset
+
+
+def compute_reference_optimum(
+    dataset: HostDataset, reg_param: float, *, max_iter: int = 5000, tol: float = 1e-9
+) -> tuple[np.ndarray, float]:
+    """Return (w_opt [d], f_opt) for the dataset's problem type."""
+    from sklearn.linear_model import LogisticRegression, Ridge
+
+    from distributed_optimization_tpu.ops import losses_np
+
+    X_no_bias = dataset.X_full[:, :-1]
+    y = dataset.y_full
+    n_samples = dataset.X_full.shape[0]
+    sklearn_alpha = reg_param * n_samples  # mean-loss λ -> sklearn total-loss α
+
+    if dataset.problem_type == "logistic":
+        C = 1.0 / sklearn_alpha if sklearn_alpha > 1e-12 else 1e12
+        solver = LogisticRegression(
+            C=C,
+            fit_intercept=True,
+            solver="saga",
+            max_iter=max_iter,
+            tol=tol,
+            random_state=42,
+        )
+        solver.fit(X_no_bias, y)
+        w_opt = np.concatenate([solver.coef_.ravel(), solver.intercept_])
+        f_opt = losses_np.logistic_objective(w_opt, dataset.X_full, y, reg_param)
+    elif dataset.problem_type == "quadratic":
+        solver = Ridge(
+            alpha=sklearn_alpha,
+            fit_intercept=True,
+            solver="saga",
+            max_iter=max_iter,
+            tol=tol,
+            random_state=42,
+        )
+        solver.fit(X_no_bias, y)
+        w_opt = np.concatenate([solver.coef_.ravel(), np.atleast_1d(solver.intercept_)])
+        f_opt = losses_np.quadratic_objective(w_opt, dataset.X_full, y, reg_param)
+    else:
+        raise ValueError(f"Unknown problem type: {dataset.problem_type}")
+
+    return w_opt, f_opt

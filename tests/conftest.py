@@ -1,0 +1,30 @@
+"""Test configuration: run JAX on 8 virtual CPU devices.
+
+Multi-device tests (sharding, shard_map/ppermute collectives) run without TPU
+hardware via XLA's host-platform device-count override — the same mechanism
+the driver's multi-chip dry-run uses. Must be set before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin's sitecustomize pins jax_platforms via jax.config
+# (which overrides the env var), so re-pin CPU explicitly before any backend
+# is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

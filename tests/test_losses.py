@@ -1,0 +1,112 @@
+"""Math-core tests: JAX kernels vs numpy twins, jax.grad, finite differences."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.models import get_problem
+from distributed_optimization_tpu.ops import losses, losses_np
+
+
+def _random_problem_data(rng, n=64, d=13, problem="logistic"):
+    X = rng.normal(size=(n, d))
+    if problem == "logistic":
+        y = rng.choice([-1.0, 1.0], size=n)
+    else:
+        y = rng.normal(size=n)
+    w = rng.normal(size=d)
+    return w, X, y
+
+
+@pytest.mark.parametrize("problem", ["logistic", "quadratic"])
+def test_jax_matches_numpy(rng, problem):
+    w, X, y = _random_problem_data(rng, problem=problem)
+    reg = 1e-3
+    p = get_problem(problem)
+    obj_np = losses_np.OBJECTIVES[problem](w, X, y, reg)
+    grad_np = losses_np.GRADIENTS[problem](w, X, y, reg)
+    obj_j = p.objective(jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), reg)
+    grad_j = p.gradient(jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), reg)
+    np.testing.assert_allclose(float(obj_j), obj_np, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(grad_j), grad_np, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("problem", ["logistic", "quadratic"])
+def test_gradient_matches_jax_grad(rng, problem):
+    w, X, y = _random_problem_data(rng, problem=problem)
+    reg = 1e-3
+    p = get_problem(problem)
+    auto = jax.grad(lambda ww: p.objective(ww, jnp.asarray(X), jnp.asarray(y), reg))(
+        jnp.asarray(w, dtype=jnp.float32)
+    )
+    closed = p.gradient(jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), reg)
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(closed), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("problem", ["logistic", "quadratic"])
+def test_gradient_matches_finite_differences(rng, problem):
+    w, X, y = _random_problem_data(rng, n=16, d=7, problem=problem)
+    reg = 1e-2
+    obj = losses_np.OBJECTIVES[problem]
+    grad = losses_np.GRADIENTS[problem](w, X, y, reg)
+    eps = 1e-6
+    fd = np.zeros_like(w)
+    for k in range(w.size):
+        e = np.zeros_like(w)
+        e[k] = eps
+        fd[k] = (obj(w + e, X, y, reg) - obj(w - e, X, y, reg)) / (2 * eps)
+    np.testing.assert_allclose(grad, fd, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("problem", ["logistic", "quadratic"])
+def test_weighted_forms_equal_plain_mean(rng, problem):
+    w, X, y = _random_problem_data(rng, problem=problem)
+    reg = 1e-3
+    p = get_problem(problem)
+    n = X.shape[0]
+    weights = jnp.full((n,), 1.0 / n)
+    np.testing.assert_allclose(
+        float(p.objective_weighted(jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), weights, reg)),
+        float(p.objective(jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), reg)),
+        rtol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(p.gradient_weighted(jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), weights, reg)),
+        np.asarray(p.gradient(jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), reg)),
+        rtol=2e-4,
+        atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("problem", ["logistic", "quadratic"])
+def test_zero_weights_give_regularizer_gradient(rng, problem):
+    """Empty-batch semantics: zero weights ⇒ gradient is exactly reg·w."""
+    w, X, y = _random_problem_data(rng, problem=problem)
+    reg = 1e-2
+    p = get_problem(problem)
+    g = p.gradient_weighted(
+        jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), jnp.zeros(X.shape[0]), reg
+    )
+    np.testing.assert_allclose(np.asarray(g), reg * w, rtol=1e-6, atol=1e-7)
+
+
+def test_logistic_stability_extreme_margins():
+    """The stable softplus formulation must not overflow for huge logits."""
+    w = jnp.array([1000.0, -1000.0])
+    X = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    y = jnp.array([-1.0, 1.0])
+    val = losses.logistic_objective(w, X, y, 0.0)
+    assert np.isfinite(float(val))
+    g = losses.logistic_gradient(w, X, y, 0.0)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+    val_np = losses_np.logistic_objective(np.asarray(w, dtype=np.float64), np.asarray(X), np.asarray(y), 0.0)
+    assert np.isfinite(val_np)
+
+
+def test_batch_weights_semantics():
+    mask = jnp.array([1.0, 1.0, 0.0, 0.0])
+    wts = losses.batch_weights(mask)
+    np.testing.assert_allclose(np.asarray(wts), [0.5, 0.5, 0.0, 0.0])
+    np.testing.assert_allclose(np.asarray(losses.batch_weights(jnp.zeros(3))), 0.0)

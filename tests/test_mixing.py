@@ -1,0 +1,68 @@
+"""Mixing-operator tests: stencil forms ≡ dense W @ x, mean preservation."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.ops.mixing import make_mixing_op
+from distributed_optimization_tpu.parallel.topology import build_topology
+
+STENCIL_CASES = [("ring", 8), ("ring", 25), ("grid", 9), ("grid", 25), ("fully_connected", 8)]
+
+
+@pytest.mark.parametrize("name,n", STENCIL_CASES)
+def test_stencil_equals_dense(rng, name, n):
+    topo = build_topology(name, n)
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    dense = make_mixing_op(topo, impl="dense")
+    stencil = make_mixing_op(topo, impl="stencil")
+    np.testing.assert_allclose(
+        np.asarray(stencil.apply(jnp.asarray(x))),
+        np.asarray(dense.apply(jnp.asarray(x))),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stencil.neighbor_sum(jnp.asarray(x))),
+        np.asarray(dense.neighbor_sum(jnp.asarray(x))),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("name,n", [("ring", 8), ("grid", 16), ("fully_connected", 8), ("erdos_renyi", 12), ("chain", 7), ("star", 7)])
+def test_dense_matches_host_matmul(rng, name, n):
+    topo = build_topology(name, n, seed=1)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    op = make_mixing_op(topo, impl="dense")
+    np.testing.assert_allclose(
+        np.asarray(op.apply(jnp.asarray(x))), topo.mixing_matrix @ x, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(op.neighbor_sum(jnp.asarray(x))), topo.adjacency @ x, rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("name,n", STENCIL_CASES)
+def test_mixing_preserves_mean(rng, name, n):
+    """W is doubly stochastic ⇒ gossip preserves the network average."""
+    topo = build_topology(name, n)
+    op = make_mixing_op(topo)
+    x = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(jnp.mean(op.apply(x), axis=0)),
+        np.asarray(jnp.mean(x, axis=0)),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_stencil_rejected_for_irregular_graph():
+    topo = build_topology("erdos_renyi", 10, seed=0)
+    with pytest.raises(ValueError):
+        make_mixing_op(topo, impl="stencil")
+
+
+def test_auto_picks_stencil_for_regular_graphs():
+    assert make_mixing_op(build_topology("ring", 8)).impl == "stencil"
+    assert make_mixing_op(build_topology("erdos_renyi", 8, seed=0)).impl == "dense"

@@ -1,0 +1,77 @@
+"""Per-worker PRNG sampling tests: without-replacement, masking, determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_optimization_tpu.ops.sampling import (
+    sample_batch_indices,
+    sample_worker_batches,
+)
+
+
+def test_without_replacement_and_weights():
+    key = jax.random.key(0)
+    idx, wts = sample_batch_indices(key, n_local=50, n_valid=jnp.asarray(50), batch_size=16)
+    idx = np.asarray(idx)
+    assert idx.shape == (16,)
+    assert len(np.unique(idx)) == 16  # without replacement
+    assert np.all((idx >= 0) & (idx < 50))
+    np.testing.assert_allclose(np.asarray(wts), 1.0 / 16)
+
+
+def test_short_shard_effective_batch():
+    """n_valid < batch_size: weights encode effective batch = n_valid."""
+    key = jax.random.key(1)
+    idx, wts = sample_batch_indices(key, n_local=50, n_valid=jnp.asarray(5), batch_size=16)
+    idx, wts = np.asarray(idx), np.asarray(wts)
+    # Real draws come first and all lie in the valid range.
+    assert np.all(idx[:5] < 5)
+    assert len(np.unique(idx[:5])) == 5
+    np.testing.assert_allclose(wts[:5], 1.0 / 5)
+    np.testing.assert_allclose(wts[5:], 0.0)
+    np.testing.assert_allclose(wts.sum(), 1.0, rtol=1e-6)
+
+
+def test_batch_size_exceeds_shard_capacity():
+    """batch_size > n_local (tiny shards): clamp, don't crash (regression)."""
+    key = jax.random.key(7)
+    idx, wts = sample_batch_indices(key, n_local=1, n_valid=jnp.asarray(1), batch_size=4)
+    idx, wts = np.asarray(idx), np.asarray(wts)
+    assert idx.shape == (4,) and np.all(idx == 0)
+    np.testing.assert_allclose(wts, [1.0, 0.0, 0.0, 0.0])
+
+
+def test_empty_shard_zero_weights():
+    key = jax.random.key(2)
+    _, wts = sample_batch_indices(key, n_local=10, n_valid=jnp.asarray(0), batch_size=4)
+    np.testing.assert_allclose(np.asarray(wts), 0.0)
+
+
+def test_worker_batches_shapes_and_independence():
+    key = jax.random.key(3)
+    N, L, d, b = 6, 20, 4, 8
+    X = jnp.arange(N * L * d, dtype=jnp.float32).reshape(N, L, d)
+    y = jnp.arange(N * L, dtype=jnp.float32).reshape(N, L)
+    n_valid = jnp.full((N,), L)
+    Xb, yb, w = sample_worker_batches(key, jnp.asarray(0), X, y, n_valid, b)
+    assert Xb.shape == (N, b, d) and yb.shape == (N, b) and w.shape == (N, b)
+    # Batch rows must come from the right worker's shard.
+    for i in range(N):
+        assert np.all(np.isin(np.asarray(yb[i]), np.asarray(y[i])))
+    # Different workers / steps draw differently (overwhelmingly likely).
+    Xb2, _, _ = sample_worker_batches(key, jnp.asarray(1), X, y, n_valid, b)
+    assert not np.array_equal(np.asarray(Xb), np.asarray(Xb2))
+    # Determinism: same key + step reproduces exactly.
+    Xb3, _, _ = sample_worker_batches(key, jnp.asarray(0), X, y, n_valid, b)
+    np.testing.assert_array_equal(np.asarray(Xb), np.asarray(Xb3))
+
+
+def test_sampling_is_jittable():
+    f = jax.jit(
+        lambda key, step, X, y, nv: sample_worker_batches(key, step, X, y, nv, 4)
+    )
+    X = jnp.ones((3, 10, 2))
+    y = jnp.ones((3, 10))
+    out = f(jax.random.key(0), jnp.asarray(5), X, y, jnp.full((3,), 10))
+    assert out[0].shape == (3, 4, 2)

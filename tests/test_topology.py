@@ -1,0 +1,96 @@
+"""Topology/mixing-matrix property tests, incl. the report's spectral gaps."""
+
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.parallel.topology import (
+    build_topology,
+    ring_spectral_gap_closed_form,
+    torus_spectral_gap_closed_form,
+)
+
+ALL_TOPOLOGIES = [
+    ("ring", 25),
+    ("grid", 25),
+    ("fully_connected", 25),
+    ("erdos_renyi", 16),
+    ("chain", 10),
+    ("star", 10),
+]
+
+
+@pytest.mark.parametrize("name,n", ALL_TOPOLOGIES)
+def test_mixing_matrix_invariants(name, n):
+    topo = build_topology(name, n, seed=3)
+    W = topo.mixing_matrix
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+    np.testing.assert_allclose(W, W.T, atol=1e-12)
+    assert np.all(W >= -1e-12)
+    # Support structure: off-diagonal nonzeros exactly where edges are.
+    off = W.copy()
+    np.fill_diagonal(off, 0.0)
+    assert np.array_equal(off > 1e-15, topo.adjacency > 0)
+    # Adjacency is symmetric with a zero diagonal.
+    assert np.array_equal(topo.adjacency, topo.adjacency.T)
+    assert np.all(np.diag(topo.adjacency) == 0)
+
+
+def test_degrees():
+    assert np.all(build_topology("ring", 25).degrees == 2)
+    assert np.all(build_topology("grid", 25).degrees == 4)
+    assert np.all(build_topology("fully_connected", 25).degrees == 24)
+    star = build_topology("star", 10)
+    assert star.degrees[0] == 9 and np.all(star.degrees[1:] == 1)
+    chain = build_topology("chain", 10)
+    assert chain.degrees[0] == chain.degrees[-1] == 1
+    assert np.all(chain.degrees[1:-1] == 2)
+
+
+def test_report_spectral_gaps():
+    """The study's published spectral gaps (report §III-A / SURVEY.md §6)."""
+    assert build_topology("ring", 25).spectral_gap == pytest.approx(0.0209, abs=5e-5)
+    assert build_topology("grid", 25).spectral_gap == pytest.approx(0.2764, abs=5e-5)
+    assert build_topology("fully_connected", 25).spectral_gap == pytest.approx(1.0, abs=1e-10)
+
+
+def test_closed_form_gaps_match_eigendecomposition():
+    for n in (5, 8, 25, 64):
+        assert build_topology("ring", n).spectral_gap == pytest.approx(
+            ring_spectral_gap_closed_form(n), abs=1e-9
+        )
+    for side in (3, 5, 8):
+        assert build_topology("grid", side * side).spectral_gap == pytest.approx(
+            torus_spectral_gap_closed_form(side), abs=1e-9
+        )
+
+
+def test_grid_requires_perfect_square():
+    with pytest.raises(ValueError):
+        build_topology("grid", 24)
+
+
+def test_erdos_renyi_connected_and_seeded():
+    t1 = build_topology("erdos_renyi", 16, erdos_renyi_p=0.3, seed=7)
+    t2 = build_topology("erdos_renyi", 16, erdos_renyi_p=0.3, seed=7)
+    assert np.array_equal(t1.adjacency, t2.adjacency)
+    # Connectivity: powers of (A + I) reach everything.
+    reach = np.linalg.matrix_power(t1.adjacency + np.eye(16), 15) > 0
+    assert reach.all()
+
+
+def test_comms_cost_closed_forms():
+    """Floats-transmitted closed forms vs the reference's Tables I/II."""
+    from distributed_optimization_tpu import metrics
+
+    d, T = 81, 10_000
+    assert metrics.centralized_floats_per_iteration(25, d) * T == pytest.approx(4.050e7)
+    ring = build_topology("ring", 25)
+    grid = build_topology("grid", 25)
+    fc = build_topology("fully_connected", 25)
+    assert metrics.decentralized_floats_per_iteration(ring, d) * T == pytest.approx(4.050e7)
+    assert metrics.decentralized_floats_per_iteration(grid, d) * T == pytest.approx(8.100e7)
+    assert metrics.decentralized_floats_per_iteration(fc, d) * T == pytest.approx(4.860e8)
+    # Gradient tracking gossips two arrays per iteration.
+    assert metrics.decentralized_floats_per_iteration(ring, d, "gradient_tracking") == pytest.approx(
+        2 * 2 * 25 * d
+    )
