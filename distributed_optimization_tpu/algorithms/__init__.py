@@ -1,0 +1,4 @@
+"""Optimization algorithms: centralized SGD, D-SGD, gradient tracking, EXTRA,
+decentralized (linearized) ADMM — as pure, jittable step rules."""
+
+from distributed_optimization_tpu.algorithms.base import Algorithm, get_algorithm  # noqa: F401

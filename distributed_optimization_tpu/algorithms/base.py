@@ -1,0 +1,93 @@
+"""Algorithm abstraction: a pure init/step pair over an [N, d] model stack.
+
+The reference hard-wires its two algorithms as stateful trainer classes with
+Python worker loops (reference ``trainer.py:7-74`` centralized,
+``trainer.py:154-197`` D-SGD). Here an algorithm is a *pure step rule* over a
+pytree state whose leaves are ``[N, d]``-stacked arrays, so the same rule
+
+- runs inside ``jax.lax.scan`` under ``jit`` on the TPU path,
+- runs step-at-a-time under numpy on the fidelity path, and
+- is agnostic to how its collectives are realized (the ``StepContext``
+  carries ``mix``/``neighbor_sum`` closures that may be a dense matmul, a
+  GSPMD stencil, or explicit shard_map ppermute/psum collectives).
+
+Every state pytree has an ``x: [N, d]`` leaf (per-worker models). The
+centralized algorithm keeps all rows identical — its "mixing" is the exact
+all-reduce mean a parameter server performs, which on the mesh compiles to a
+single ``psum`` (SURVEY.md C3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict
+
+import jax
+
+Array = Any  # jax.Array or np.ndarray — algorithms are backend-polymorphic
+State = Dict[str, Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class StepContext:
+    """Everything a step rule may touch, with backend-supplied semantics.
+
+    ``grad(params, slot)``: stochastic gradient of the local objective at
+    ``params`` ([N, d] -> [N, d]); ``slot`` (an int) distinguishes multiple
+    independent batch draws within one iteration, so algorithms that need two
+    gradient evaluations stay reproducible.
+    ``mix``: x -> W x (gossip averaging).
+    ``neighbor_sum``: x -> A x (sum over graph neighbors, for ADMM).
+    ``eta``: learning rate for this iteration (scalar).
+    ``degrees``: [N, 1] node degrees.
+    ``config``: the ExperimentConfig (static hyperparameters only).
+    """
+
+    grad: Callable[[Array, int], Array]
+    mix: Callable[[Array], Array]
+    neighbor_sum: Callable[[Array], Array]
+    eta: Array
+    t: Array
+    degrees: Array
+    config: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Algorithm:
+    """A named pure step rule.
+
+    ``init(x0, config) -> state``: build the state pytree from [N, d] init.
+    ``step(state, ctx) -> state``: one synchronous iteration.
+    ``gossip_rounds``: model-sized gossip exchanges per iteration (for the
+    analytic floats-transmitted metric, reference trainer.py:169-170).
+    ``is_decentralized``: False for the parameter-server pattern (its comms
+    cost is 2·N·d per iteration instead, reference trainer.py:44-61).
+    """
+
+    name: str
+    init: Callable[..., State]
+    step: Callable[[State, StepContext], State]
+    gossip_rounds: int = 1
+    is_decentralized: bool = True
+
+
+_REGISTRY: dict[str, Algorithm] = {}
+
+
+def register_algorithm(algo: Algorithm) -> Algorithm:
+    _REGISTRY[algo.name] = algo
+    return algo
+
+
+def get_algorithm(name: str) -> Algorithm:
+    from distributed_optimization_tpu.algorithms import (  # noqa: F401
+        admm,
+        centralized,
+        dsgd,
+        extra,
+        gradient_tracking,
+    )
+
+    if name not in _REGISTRY:
+        raise ValueError(f"Unknown algorithm: {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]

@@ -1,0 +1,40 @@
+"""Decentralized SGD (D-SGD / DGD, D-PSGD form of Lian et al. 2017).
+
+Capability parity with reference ``trainer.py:154-197``: each iteration every
+worker computes its stochastic gradient at its *local, pre-mix* model
+(trainer.py:166 — the D-PSGD ordering), gossips models through the mixing
+matrix, and steps:
+
+    x_{i,t+1} = Σ_j W_ij x_{j,t} − η_t g_i(x_{i,t})
+
+Communication cost is Σ_i deg_i · d floats per iteration (trainer.py:169-170).
+
+TPU-native form: the gossip Σ_j W_ij x_j is ``ctx.mix`` — a ppermute stencil
+(ring/torus), an all-reduce mean (fully connected), or a dense contraction
+(irregular graphs) — instead of the reference's simulated ``W @ models``.
+"""
+
+from __future__ import annotations
+
+from distributed_optimization_tpu.algorithms.base import (
+    Algorithm,
+    State,
+    StepContext,
+    register_algorithm,
+)
+
+
+def _init(x0, config) -> State:
+    return {"x": x0}
+
+
+def _step(state: State, ctx: StepContext) -> State:
+    x = state["x"]
+    grads = ctx.grad(x, 0)  # at the local pre-mix models (D-PSGD ordering)
+    x_new = ctx.mix(x) - ctx.eta * grads
+    return {"x": x_new}
+
+
+DSGD = register_algorithm(
+    Algorithm(name="dsgd", init=_init, step=_step, gossip_rounds=1)
+)

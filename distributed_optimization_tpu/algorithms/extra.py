@@ -1,0 +1,47 @@
+"""EXTRA (Shi, Ling, Wu, Yin 2015): exact first-order decentralized method.
+
+Not present in the reference (planned capability from BASELINE.json). EXTRA
+corrects D-SGD's constant-stepsize bias with a one-step memory:
+
+    x_1     = W x_0 − η g(x_0)
+    x_{t+1} = (I + W) x_t − W̃ x_{t-1} − η (g(x_t) − g(x_{t-1})),  W̃ = (I+W)/2
+
+With a constant step size it converges to the exact consensus optimum on
+convex problems where DGD stalls at a bias floor. One model-sized gossip per
+iteration: x_t is mixed once; the W̃ x_{t-1} term reuses the *previous*
+iteration's mix result, so no extra communication round is needed
+(``mix_x_prev`` is carried in the state).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from distributed_optimization_tpu.algorithms.base import (
+    Algorithm,
+    State,
+    StepContext,
+    register_algorithm,
+)
+
+
+def _init(x0, config) -> State:
+    zeros = jnp.zeros_like(x0)
+    return {"x": x0, "x_prev": x0, "mix_x_prev": zeros, "g_prev": zeros}
+
+
+def _step(state: State, ctx: StepContext) -> State:
+    x, x_prev = state["x"], state["x_prev"]
+    g = ctx.grad(x, 0)
+    mix_x = ctx.mix(x)
+    # W̃ x_{t-1} = (x_{t-1} + W x_{t-1}) / 2, reusing last iteration's mix.
+    w_tilde_x_prev = 0.5 * (x_prev + state["mix_x_prev"])
+    general = x + mix_x - w_tilde_x_prev - ctx.eta * (g - state["g_prev"])
+    first = mix_x - ctx.eta * g  # the special t = 0 step
+    x_new = jnp.where(ctx.t == 0, first, general)
+    return {"x": x_new, "x_prev": x, "mix_x_prev": mix_x, "g_prev": g}
+
+
+EXTRA = register_algorithm(
+    Algorithm(name="extra", init=_init, step=_step, gossip_rounds=1)
+)

@@ -1,0 +1,3 @@
+"""Execution backends: 'jax' (TPU/XLA north star) and 'numpy' (fidelity oracle)."""
+
+from distributed_optimization_tpu.backends.base import BackendRunResult, run_algorithm  # noqa: F401

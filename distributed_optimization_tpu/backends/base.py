@@ -1,0 +1,44 @@
+"""Backend dispatch and the shared run-result container.
+
+Mirrors the reference's trainer contract — ``run(...) -> (history, final
+model)`` plus a ``total_floats_transmitted`` attribute (reference
+``trainer.py:33,74,154,197``, read at ``simulator.py:81``) — as one dataclass
+returned by every backend, so the simulator layer is backend-agnostic (the
+``--backend`` selection named in BASELINE.json's north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from distributed_optimization_tpu.metrics import RunHistory
+
+
+@dataclasses.dataclass
+class BackendRunResult:
+    history: RunHistory
+    final_models: np.ndarray  # [N, d] per-worker models after T iterations
+    final_avg_model: np.ndarray  # [d] network average (the reported model)
+
+    @property
+    def total_floats_transmitted(self) -> float:
+        return self.history.total_floats_transmitted
+
+
+def run_algorithm(config, dataset, f_opt, **kwargs) -> BackendRunResult:
+    """Run ``config.algorithm`` on ``config.backend`` over ``dataset``.
+
+    ``dataset`` is a HostDataset; backends derive their preferred layout.
+    Extra kwargs are backend-specific (mesh=..., batch_schedule=..., ...).
+    """
+    if config.backend == "jax":
+        from distributed_optimization_tpu.backends import jax_backend
+
+        return jax_backend.run(config, dataset, f_opt, **kwargs)
+    if config.backend == "numpy":
+        from distributed_optimization_tpu.backends import numpy_backend
+
+        return numpy_backend.run(config, dataset, f_opt, **kwargs)
+    raise ValueError(f"Unknown backend: {config.backend!r}")

@@ -1,0 +1,159 @@
+"""The numpy fidelity-oracle backend: reference-semantics simulator.
+
+Mirrors the reference's single-process execution model (SURVEY.md §0) —
+host-side float64 numpy, per-iteration Python loop, dense ``W @ models``
+gossip, full-dataset objective evaluated on the host every iteration — so it
+
+1. anchors metric/convergence parity with the reference's published numbers,
+2. provides the CPU iters/sec baseline the north-star speedup is measured
+   against (BASELINE.json), and
+3. serves as the equivalence oracle for the JAX backend (identical injected
+   batches must produce matching trajectories — SURVEY.md §4c).
+
+Covers the two algorithms the reference implements (centralized SGD,
+D-SGD) via the same shared step rules the JAX backend uses; the extended
+algorithms (gradient tracking / EXTRA / ADMM) are JAX-backend capabilities
+(their step rules use jnp and have no reference counterpart to be an oracle
+for).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from distributed_optimization_tpu.algorithms import get_algorithm
+from distributed_optimization_tpu.algorithms.base import StepContext
+from distributed_optimization_tpu.backends.base import BackendRunResult
+from distributed_optimization_tpu.metrics import (
+    RunHistory,
+    centralized_floats_per_iteration,
+    consensus_error,
+    decentralized_floats_per_iteration,
+)
+from distributed_optimization_tpu.ops import losses_np
+from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.utils.data import HostDataset
+
+_SUPPORTED = ("centralized", "dsgd")
+
+
+def run(
+    config,
+    dataset: HostDataset,
+    f_opt: float,
+    *,
+    batch_schedule: Optional[np.ndarray] = None,
+    collect_metrics: bool = True,
+) -> BackendRunResult:
+    if config.algorithm not in _SUPPORTED:
+        raise ValueError(
+            f"numpy backend implements {_SUPPORTED} (the reference's algorithm "
+            f"set); {config.algorithm!r} is a jax-backend capability"
+        )
+    algo = get_algorithm(config.algorithm)
+    T = config.n_iterations
+    n = config.n_workers
+    d = dataset.n_features
+    reg = config.reg_param
+    objective = losses_np.OBJECTIVES[config.problem_type]
+    gradient = losses_np.GRADIENTS[config.problem_type]
+
+    shards = [dataset.shard(i) for i in range(n)]
+    shard_sizes = [Xi.shape[0] for Xi, _ in shards]
+
+    if algo.is_decentralized:
+        topo = build_topology(
+            config.topology, n, erdos_renyi_p=config.erdos_renyi_p, seed=config.seed
+        )
+        W = topo.mixing_matrix
+        A = topo.adjacency
+        degrees = topo.degrees[:, None]
+        floats_per_iter = decentralized_floats_per_iteration(
+            topo, d, algo.gossip_rounds
+        )
+        spectral_gap = topo.spectral_gap
+    else:
+        topo, W, A = None, None, None
+        degrees = np.zeros((n, 1))
+        floats_per_iter = centralized_floats_per_iteration(n, d)
+        spectral_gap = None
+
+    rng = np.random.default_rng(config.seed)
+    eta0 = config.learning_rate_eta0
+    sqrt_decay = config.resolved_lr_schedule() == "sqrt_decay"
+
+    def sample_indices(t: int, i: int) -> np.ndarray:
+        if batch_schedule is not None:
+            return batch_schedule[t, i]
+        ni = shard_sizes[i]
+        b = min(config.local_batch_size, ni)
+        if b <= 0:
+            return np.empty(0, dtype=np.int64)
+        return rng.choice(ni, size=b, replace=False)
+
+    def make_grad(t: int):
+        def grad(params: np.ndarray, slot: int) -> np.ndarray:
+            out = np.zeros((n, d))
+            for i in range(n):
+                Xi, yi = shards[i]
+                idx = sample_indices(t, i)
+                out[i] = gradient(params[i], Xi[idx], yi[idx], reg)
+            return out
+
+        return grad
+
+    state = {k: np.asarray(v, dtype=np.float64) for k, v in
+             algo.init(np.zeros((n, d)), config).items()}
+
+    eval_every = config.eval_every
+    n_evals = T // eval_every
+    track_consensus = (
+        collect_metrics and algo.is_decentralized and config.record_consensus
+    )
+    gap_hist = np.full(n_evals, np.nan)
+    cons_hist = np.full(n_evals, np.nan)
+    time_hist = np.empty(n_evals)
+    start = time.perf_counter()
+
+    for t in range(T):
+        eta = eta0 / np.sqrt(t + 1.0) if sqrt_decay else eta0
+        ctx = StepContext(
+            grad=make_grad(t),
+            mix=(lambda v: W @ v) if W is not None else (lambda v: v),
+            neighbor_sum=(lambda v: A @ v) if A is not None else (lambda v: v * 0),
+            eta=eta,
+            t=t,
+            degrees=degrees,
+            config=config,
+        )
+        state = algo.step(state, ctx)
+        if (t + 1) % eval_every == 0:
+            k = (t + 1) // eval_every - 1
+            x = state["x"]
+            if collect_metrics:
+                xbar = x.mean(axis=0)
+                gap_hist[k] = (
+                    objective(xbar, dataset.X_full, dataset.y_full, reg) - f_opt
+                )
+                if track_consensus:
+                    cons_hist[k] = consensus_error(x)
+            time_hist[k] = time.perf_counter() - start
+
+    run_seconds = time.perf_counter() - start
+
+    history = RunHistory(
+        objective=gap_hist,
+        consensus_error=cons_hist if track_consensus else None,
+        time=time_hist,
+        eval_iterations=np.arange(eval_every, T + 1, eval_every),
+        total_floats_transmitted=floats_per_iter * T,
+        iters_per_second=T / run_seconds if run_seconds > 0 else float("inf"),
+    )
+    history.spectral_gap = spectral_gap  # type: ignore[attr-defined]
+    final = state["x"]
+    return BackendRunResult(
+        history=history, final_models=final, final_avg_model=final.mean(axis=0)
+    )

@@ -52,6 +52,15 @@ class ExperimentConfig:
     backend: str = "jax"  # 'jax' (TPU/XLA north star) | 'numpy' (fidelity oracle)
     algorithm: str = "dsgd"
     topology: str = "ring"
+    # LR schedule: 'auto' = the reference's eta0/sqrt(t+1) decay
+    # (trainer.py:17-19) for SGD-family algorithms, constant eta0 for
+    # gradient_tracking/extra/admm (their linear-convergence regimes).
+    lr_schedule: str = "auto"  # 'auto' | 'sqrt_decay' | 'constant'
+    admm_c: float = 0.5  # ADMM edge-penalty coefficient
+    # DLM proximal-linearization weight; must dominate the loss gradient's
+    # Lipschitz constant for stability (L ≈ 4 for the standardized quadratic
+    # data here, ≈ 0.25 for logistic). 5.0 is safe for both study problems.
+    admm_rho: float = 5.0
     seed: int = 203  # reference seeds np.random.seed(203) at main.py:24
     eval_every: int = 1  # full-data objective eval cadence (reference: every iter)
     erdos_renyi_p: float = 0.4  # edge probability for the ER topology
@@ -71,14 +80,35 @@ class ExperimentConfig:
             raise ValueError(f"Unknown backend: {self.backend}")
         if self.mixing_impl not in ("auto", "dense", "stencil", "shard_map"):
             raise ValueError(f"Unknown mixing impl: {self.mixing_impl}")
+        if self.lr_schedule not in ("auto", "sqrt_decay", "constant"):
+            raise ValueError(f"Unknown lr schedule: {self.lr_schedule}")
         if self.n_workers <= 0:
             raise ValueError("n_workers must be positive")
+        if self.n_informative_features > self.n_features:
+            raise ValueError(
+                f"n_informative_features ({self.n_informative_features}) cannot "
+                f"exceed n_features ({self.n_features})"
+            )
+        if self.eval_every <= 0:
+            raise ValueError("eval_every must be positive")
+        if self.n_iterations % self.eval_every != 0:
+            raise ValueError(
+                f"eval_every ({self.eval_every}) must divide n_iterations "
+                f"({self.n_iterations})"
+            )
         if self.topology == "grid":
             side = int(math.isqrt(self.n_workers))
             if side * side != self.n_workers:
                 raise ValueError(
                     f"grid topology requires a perfect-square worker count, got {self.n_workers}"
                 )
+
+    def resolved_lr_schedule(self) -> str:
+        if self.lr_schedule != "auto":
+            return self.lr_schedule
+        return (
+            "sqrt_decay" if self.algorithm in ("centralized", "dsgd") else "constant"
+        )
 
     # The regularizer actually used for the gradient/objective: the reference
     # uses lambda for logistic and mu (== lambda by default) for quadratic

@@ -27,11 +27,6 @@ import numpy as np
 
 from distributed_optimization_tpu.parallel.topology import Topology
 
-# Gossip rounds per iteration for each decentralized algorithm: gradient
-# tracking mixes both the model and the tracker array each iteration
-# (2 rounds); D-SGD / EXTRA / ADMM exchange one model-sized vector per
-# neighbor per iteration.
-GOSSIP_ROUNDS_PER_ITER = {"dsgd": 1, "extra": 1, "gradient_tracking": 2, "admm": 1}
 
 
 @dataclasses.dataclass
@@ -89,15 +84,16 @@ def centralized_floats_per_iteration(n_workers: int, n_features: int) -> float:
 
 
 def decentralized_floats_per_iteration(
-    topo: Topology, n_features: int, algorithm: str = "dsgd"
+    topo: Topology, n_features: int, gossip_rounds: int = 1
 ) -> float:
-    """Σ_i deg_i · d floats per gossip round, times rounds for the algorithm.
+    """Σ_i deg_i · d floats per gossip round, times the algorithm's rounds
+    (``Algorithm.gossip_rounds``: 2 for gradient tracking, which mixes both
+    the model and tracker arrays; 1 otherwise).
 
     Parity: reference ``trainer.py:169-170``. Closed form ΣdegᵢdT gives
     4.05e7 (ring) / 8.1e7 (grid) / 4.86e8 (fc) for the report config.
     """
-    rounds = GOSSIP_ROUNDS_PER_ITER.get(algorithm, 1)
-    return topo.floats_per_iteration * n_features * rounds
+    return topo.floats_per_iteration * n_features * gossip_rounds
 
 
 @dataclasses.dataclass

@@ -1,0 +1,148 @@
+"""Explicit-collective mixing operators: shard_map + ppermute/psum over ICI.
+
+This is the north-star communication backend (SURVEY.md §5.8, C12): each
+device holds a contiguous block of workers, and one gossip round exchanges
+only the block-boundary rows with the neighboring devices via
+``jax.lax.ppermute`` (ring/torus) or reduces with ``jax.lax.psum`` (fully
+connected / centralized). This replaces the reference's simulated dense
+``W @ models`` matmul (reference ``trainer.py:173``) with the real collective
+traffic pattern: a ring of N workers on D devices moves exactly 2·d floats
+per device per round over ICI, independent of N.
+
+The GSPMD stencils in ``ops/mixing.py`` compile to the same collectives
+automatically; this module is the manually scheduled form — used when
+``mixing_impl='shard_map'`` — and doubles as executable documentation of the
+communication pattern. Property tests check both against the dense matrix.
+
+Intra-block neighbor averaging is pure local compute; only the first/last
+rows of each block cross device boundaries. Worker blocks are contiguous
+(worker i lives at block row i % (N/D) on device i // (N/D)), matching the
+``NamedSharding`` layout that ``mesh.shard_over_workers`` produces.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_optimization_tpu.ops.mixing import MixingOp
+from distributed_optimization_tpu.parallel.mesh import WORKER_AXIS
+from distributed_optimization_tpu.parallel.topology import Topology
+
+
+def _ring_block_mix(axis: str, n_devices: int, w: float):
+    """Per-block ring stencil: local shifts + edge-row ppermutes."""
+    fwd = [(i, (i + 1) % n_devices) for i in range(n_devices)]
+    bwd = [(i, (i - 1) % n_devices) for i in range(n_devices)]
+
+    def exchange(block):  # block: [per, d] on each device
+        # Row arriving from the previous device (their last worker) and the
+        # next device (their first worker).
+        from_prev = jax.lax.ppermute(block[-1:], axis, fwd)
+        from_next = jax.lax.ppermute(block[:1], axis, bwd)
+        left = jnp.concatenate([from_prev, block[:-1]], axis=0)  # x_{i-1}
+        right = jnp.concatenate([block[1:], from_next], axis=0)  # x_{i+1}
+        return left, right
+
+    def mix(block):
+        left, right = exchange(block)
+        return (w * (block + left + right)).astype(block.dtype)
+
+    def nbr(block):
+        left, right = exchange(block)
+        return (left + right).astype(block.dtype)
+
+    return mix, nbr
+
+
+def _fc_block_ops(axis: str, n_total: int):
+    def mix(block):
+        total = jax.lax.psum(jnp.sum(block, axis=0, keepdims=True), axis)
+        return jnp.broadcast_to(total / n_total, block.shape).astype(block.dtype)
+
+    def nbr(block):
+        total = jax.lax.psum(jnp.sum(block, axis=0, keepdims=True), axis)
+        return (total - block).astype(block.dtype)
+
+    return mix, nbr
+
+
+def _grid_block_ops(axis: str, n_devices: int, rows: int, cols: int, w: float):
+    """Torus stencil with the row axis blocked over devices.
+
+    Each device holds rows_per_dev full grid rows ([rows_per_dev, cols, d]);
+    column rolls are local, row rolls exchange one boundary grid-row (cols·d
+    floats) with each neighboring device.
+    """
+    fwd = [(i, (i + 1) % n_devices) for i in range(n_devices)]
+    bwd = [(i, (i - 1) % n_devices) for i in range(n_devices)]
+
+    def shifts(block):  # [r_loc, cols, d]
+        from_prev = jax.lax.ppermute(block[-1:], axis, fwd)
+        from_next = jax.lax.ppermute(block[:1], axis, bwd)
+        up = jnp.concatenate([from_prev, block[:-1]], axis=0)
+        down = jnp.concatenate([block[1:], from_next], axis=0)
+        lateral = jnp.roll(block, 1, axis=1) + jnp.roll(block, -1, axis=1)
+        return up + down + lateral
+
+    def mix(block):
+        return (w * (block + shifts(block))).astype(block.dtype)
+
+    def nbr(block):
+        return shifts(block).astype(block.dtype)
+
+    return mix, nbr
+
+
+def make_shard_map_mixing_op(topo: Topology, mesh: Mesh) -> MixingOp:
+    """Build the explicit shard_map collective mixing op for a topology.
+
+    Supports the mesh-embeddable graphs (ring, torus grid, fully connected).
+    Irregular graphs (Erdős–Rényi, chain, star) use the dense form instead
+    (SURVEY.md §7 hard part (c)).
+    """
+    axis = WORKER_AXIS
+    n_devices = mesh.shape[axis]
+    n = topo.n
+    if n % n_devices != 0:
+        raise ValueError(f"n_workers={n} not divisible by mesh size {n_devices}")
+
+    if topo.name == "ring":
+        if n < 3:
+            raise ValueError("shard_map ring mixing needs n >= 3")
+        mix_block, nbr_block = _ring_block_mix(axis, n_devices, 1.0 / 3.0)
+        spec_in = P(axis, None)
+    elif topo.name == "fully_connected":
+        mix_block, nbr_block = _fc_block_ops(axis, n)
+        spec_in = P(axis, None)
+    elif topo.name == "grid":
+        rows, cols = topo.grid_shape  # type: ignore[misc]
+        if min(rows, cols) < 3:
+            raise ValueError("shard_map grid mixing needs a >=3x3 torus")
+        if rows % n_devices != 0:
+            raise ValueError(
+                f"grid rows={rows} not divisible by mesh size {n_devices}"
+            )
+        mix_block, nbr_block = _grid_block_ops(axis, n_devices, rows, cols, 1.0 / 5.0)
+        spec_in = P(axis, None, None)
+    else:
+        raise ValueError(
+            f"No shard_map stencil for topology {topo.name!r}; use dense mixing"
+        )
+
+    def _wrap(block_fn):
+        if topo.name == "grid":
+            rows, cols = topo.grid_shape  # type: ignore[misc]
+
+            def fn(x):  # x: [N, d] -> grid layout -> stencil -> back
+                g = x.reshape(rows, cols, x.shape[-1])
+                out = jax.shard_map(
+                    block_fn, mesh=mesh, in_specs=spec_in, out_specs=spec_in
+                )(g)
+                return out.reshape(x.shape)
+
+            return fn
+        return jax.shard_map(block_fn, mesh=mesh, in_specs=spec_in, out_specs=spec_in)
+
+    return MixingOp(topo.name, "shard_map", _wrap(mix_block), _wrap(nbr_block))

@@ -90,7 +90,10 @@ def test_comms_cost_closed_forms():
     assert metrics.decentralized_floats_per_iteration(ring, d) * T == pytest.approx(4.050e7)
     assert metrics.decentralized_floats_per_iteration(grid, d) * T == pytest.approx(8.100e7)
     assert metrics.decentralized_floats_per_iteration(fc, d) * T == pytest.approx(4.860e8)
-    # Gradient tracking gossips two arrays per iteration.
-    assert metrics.decentralized_floats_per_iteration(ring, d, "gradient_tracking") == pytest.approx(
+    # Gradient tracking gossips two arrays per iteration (gossip_rounds=2).
+    from distributed_optimization_tpu.algorithms import get_algorithm
+
+    gt_rounds = get_algorithm("gradient_tracking").gossip_rounds
+    assert metrics.decentralized_floats_per_iteration(ring, d, gt_rounds) == pytest.approx(
         2 * 2 * 25 * d
     )
