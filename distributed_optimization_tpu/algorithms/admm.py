@@ -19,9 +19,9 @@ graphs or ppermute stencils for ring/torus. One model-sized exchange per
 iteration: the x-update reuses the neighbor sum carried from the previous
 iteration's dual update.
 
-State init assumes x_0 = 0 (the framework's and reference's zero
-initialization, reference ``worker.py:13``), so the initial neighbor sum is
-zero without a pre-scan communication round.
+``init`` cannot communicate, so the first step materializes the initial
+neighbor sum A x_0 itself (a ``jnp.where`` on ``t == 0``, mirroring EXTRA's
+first-step guard) — warm starts with x_0 ≠ 0 are handled correctly.
 """
 
 from __future__ import annotations
@@ -46,6 +46,9 @@ def _step(state: State, ctx: StepContext) -> State:
     c = ctx.config.admm_c
     rho = ctx.config.admm_rho
     deg = ctx.degrees  # [N, 1]
+    # The carried neighbor sum is only valid from the previous dual update;
+    # at t == 0 compute A x_0 directly (supports warm starts with x_0 != 0).
+    nbr_x = jnp.where(ctx.t == 0, ctx.neighbor_sum(x), nbr_x)
     g = ctx.grad(x, 0)
     x_new = (rho * x + 0.5 * c * (deg * x + nbr_x) - g - alpha) / (rho + c * deg)
     nbr_new = ctx.neighbor_sum(x_new)

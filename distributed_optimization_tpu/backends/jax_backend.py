@@ -121,7 +121,9 @@ def run(
                 raise ValueError("shard_map mixing requires a device mesh")
             mix_op = make_shard_map_mixing_op(topo, mesh)
         else:
-            mix_op = make_mixing_op(topo, impl=config.mixing_impl)
+            mix_op = make_mixing_op(
+                topo, impl=config.mixing_impl, dtype=device_data.X.dtype
+            )
         degrees = jnp.asarray(topo.degrees, dtype=device_data.X.dtype)[:, None]
         floats_per_iter = decentralized_floats_per_iteration(
             topo, device_data.n_features, algo.gossip_rounds
@@ -243,9 +245,9 @@ def run(
         eval_iterations=np.arange(eval_every, T + 1, eval_every),
         total_floats_transmitted=floats_per_iter * T,
         iters_per_second=T / run_seconds if run_seconds > 0 else float("inf"),
+        compile_seconds=compile_seconds,
+        spectral_gap=spectral_gap,
     )
-    history.compile_seconds = compile_seconds  # type: ignore[attr-defined]
-    history.spectral_gap = spectral_gap  # type: ignore[attr-defined]
     return BackendRunResult(
         history=history,
         final_models=final_models,

@@ -151,8 +151,8 @@ def run(
         eval_iterations=np.arange(eval_every, T + 1, eval_every),
         total_floats_transmitted=floats_per_iter * T,
         iters_per_second=T / run_seconds if run_seconds > 0 else float("inf"),
+        spectral_gap=spectral_gap,
     )
-    history.spectral_gap = spectral_gap  # type: ignore[attr-defined]
     final = state["x"]
     return BackendRunResult(
         history=history, final_models=final, final_avg_model=final.mean(axis=0)

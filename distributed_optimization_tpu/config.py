@@ -82,6 +82,10 @@ class ExperimentConfig:
             raise ValueError(f"Unknown mixing impl: {self.mixing_impl}")
         if self.lr_schedule not in ("auto", "sqrt_decay", "constant"):
             raise ValueError(f"Unknown lr schedule: {self.lr_schedule}")
+        if self.dtype not in ("float32", "float64", "bfloat16"):
+            raise ValueError(f"Unknown dtype: {self.dtype}")
+        if self.matmul_precision not in ("default", "high", "highest"):
+            raise ValueError(f"Unknown matmul precision: {self.matmul_precision}")
         if self.n_workers <= 0:
             raise ValueError("n_workers must be positive")
         if self.n_informative_features > self.n_features:

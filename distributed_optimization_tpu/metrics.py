@@ -39,6 +39,8 @@ class RunHistory:
     eval_iterations: np.ndarray  # iteration numbers (1-based) the rows refer to
     total_floats_transmitted: float
     iters_per_second: float = float("nan")
+    compile_seconds: float = 0.0  # AOT compile time (jax backend; 0 for numpy)
+    spectral_gap: Optional[float] = None  # 1 − ρ of the run's mixing matrix
 
     def as_dict(self) -> dict:
         out = {
