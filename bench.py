@@ -57,7 +57,7 @@ def main() -> None:
     )
     print(
         f"[bench] jax backend: {jax_ips:.1f} iters/sec "
-        f"(compile {getattr(hist, 'compile_seconds', float('nan')):.1f}s, "
+        f"(compile {hist.compile_seconds:.1f}s, "
         f"final gap {hist.objective[-1]:.4f}, "
         f"iters-to-0.08 {reached}, reference table: 9927)",
         file=sys.stderr,

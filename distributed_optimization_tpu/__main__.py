@@ -1,0 +1,5 @@
+"""``python -m distributed_optimization_tpu`` entry point."""
+
+from distributed_optimization_tpu.cli import main
+
+raise SystemExit(main())

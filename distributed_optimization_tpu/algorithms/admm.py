@@ -19,9 +19,11 @@ graphs or ppermute stencils for ring/torus. One model-sized exchange per
 iteration: the x-update reuses the neighbor sum carried from the previous
 iteration's dual update.
 
-``init`` cannot communicate, so the first step materializes the initial
-neighbor sum A x_0 itself (a ``jnp.where`` on ``t == 0``, mirroring EXTRA's
-first-step guard) — warm starts with x_0 ≠ 0 are handled correctly.
+The initial neighbor sum A x_0 is materialized once at ``init`` time (the
+backend passes its ``neighbor_sum`` collective eagerly, outside the scan), so
+warm starts with x_0 ≠ 0 are handled correctly without any per-iteration
+guard — the hot loop performs exactly one model-sized exchange, matching the
+``gossip_rounds=1`` communication accounting.
 """
 
 from __future__ import annotations
@@ -36,9 +38,10 @@ from distributed_optimization_tpu.algorithms.base import (
 )
 
 
-def _init(x0, config) -> State:
+def _init(x0, config, *, neighbor_sum=None) -> State:
     zeros = jnp.zeros_like(x0)
-    return {"x": x0, "alpha": zeros, "nbr_x": zeros}
+    nbr_x = neighbor_sum(x0) if neighbor_sum is not None else zeros
+    return {"x": x0, "alpha": zeros, "nbr_x": nbr_x}
 
 
 def _step(state: State, ctx: StepContext) -> State:
@@ -46,9 +49,6 @@ def _step(state: State, ctx: StepContext) -> State:
     c = ctx.config.admm_c
     rho = ctx.config.admm_rho
     deg = ctx.degrees  # [N, 1]
-    # The carried neighbor sum is only valid from the previous dual update;
-    # at t == 0 compute A x_0 directly (supports warm starts with x_0 != 0).
-    nbr_x = jnp.where(ctx.t == 0, ctx.neighbor_sum(x), nbr_x)
     g = ctx.grad(x, 0)
     x_new = (rho * x + 0.5 * c * (deg * x + nbr_x) - g - alpha) / (rho + c * deg)
     nbr_new = ctx.neighbor_sum(x_new)

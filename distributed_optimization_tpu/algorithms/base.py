@@ -56,7 +56,10 @@ class StepContext:
 class Algorithm:
     """A named pure step rule.
 
-    ``init(x0, config) -> state``: build the state pytree from [N, d] init.
+    ``init(x0, config, *, neighbor_sum=None) -> state``: build the state
+    pytree from the [N, d] init; ``neighbor_sum`` (x -> A x), when supplied by
+    the backend, lets algorithms that carry a neighbor aggregate (ADMM)
+    materialize it for arbitrary x0 once, eagerly, outside the scanned loop.
     ``step(state, ctx) -> state``: one synchronous iteration.
     ``gossip_rounds``: model-sized gossip exchanges per iteration (for the
     analytic floats-transmitted metric, reference trainer.py:169-170).

@@ -25,7 +25,7 @@ from distributed_optimization_tpu.algorithms.base import (
 )
 
 
-def _init(x0, config) -> State:
+def _init(x0, config, *, neighbor_sum=None) -> State:
     zeros = jnp.zeros_like(x0)
     return {"x": x0, "x_prev": x0, "mix_x_prev": zeros, "g_prev": zeros}
 

@@ -31,7 +31,7 @@ from distributed_optimization_tpu.algorithms.base import (
 )
 
 
-def _init(x0, config) -> State:
+def _init(x0, config, *, neighbor_sum=None) -> State:
     zeros = jnp.zeros_like(x0)
     return {"x": x0, "y": zeros, "g_prev": zeros}
 

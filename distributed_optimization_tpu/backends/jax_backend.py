@@ -145,7 +145,10 @@ def run(
     x0 = shard_over_workers(
         mesh, jnp.zeros((n, device_data.n_features), dtype=device_data.X.dtype)
     )
-    state0 = algo.init(x0, config)
+    state0 = algo.init(
+        x0, config,
+        neighbor_sum=mix_op.neighbor_sum if mix_op is not None else None,
+    )
     key = jax.random.key(config.seed)
 
     schedule = None

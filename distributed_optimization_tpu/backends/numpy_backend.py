@@ -106,7 +106,10 @@ def run(
         return grad
 
     state = {k: np.asarray(v, dtype=np.float64) for k, v in
-             algo.init(np.zeros((n, d)), config).items()}
+             algo.init(
+                 np.zeros((n, d)), config,
+                 neighbor_sum=(lambda v: A @ v) if A is not None else None,
+             ).items()}
 
     eval_every = config.eval_every
     n_evals = T // eval_every

@@ -1,0 +1,193 @@
+"""Command-line interface.
+
+The reference has no CLI — its entry point is hard-coded module constants
+(reference ``main.py:6-41``). This is the typed-config + real-flags layer
+SURVEY.md §5.6 calls for, including the ``--backend`` selection named in
+BASELINE.json's north star.
+
+Examples:
+
+    # the reference study, end to end, on the TPU backend:
+    python -m distributed_optimization_tpu --problem-type logistic --suite \
+        --plot logistic.png --json logistic.json
+
+    # one decentralized run:
+    python -m distributed_optimization_tpu --algorithm gradient_tracking \
+        --topology grid --n-workers 64 --n-iterations 2000
+
+    # the numpy fidelity oracle (reference semantics):
+    python -m distributed_optimization_tpu --backend numpy --suite
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from distributed_optimization_tpu.config import (
+    ALGORITHMS,
+    BACKENDS,
+    PROBLEM_TYPES,
+    TOPOLOGIES,
+    ExperimentConfig,
+)
+
+_DEFAULTS = ExperimentConfig()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="distributed_optimization_tpu",
+        description=(
+            "TPU-native decentralized optimization: centralized SGD, D-SGD, "
+            "gradient tracking, EXTRA and decentralized ADMM over graph "
+            "topologies, on a JAX/XLA collective backend or a numpy "
+            "reference-semantics oracle."
+        ),
+    )
+    run = p.add_argument_group("run selection")
+    run.add_argument("--suite", action="store_true",
+                     help="run the reference experiment matrix (centralized + "
+                          "D-SGD over ring/grid/fully-connected) instead of a "
+                          "single run")
+    run.add_argument("--algorithm", choices=ALGORITHMS,
+                     default=_DEFAULTS.algorithm)
+    run.add_argument("--topology", choices=TOPOLOGIES, default=_DEFAULTS.topology)
+    run.add_argument("--backend", choices=BACKENDS, default=_DEFAULTS.backend)
+    run.add_argument("--platform", choices=("tpu", "cpu", "auto"), default="auto",
+                     help="force the JAX platform (cpu is useful for quick "
+                          "checks and virtual multi-device runs)")
+
+    prob = p.add_argument_group("problem / data (reference main.py parity)")
+    prob.add_argument("--problem-type", choices=PROBLEM_TYPES,
+                      default=_DEFAULTS.problem_type)
+    prob.add_argument("--n-workers", type=int, default=_DEFAULTS.n_workers)
+    prob.add_argument("--n-samples", type=int, default=_DEFAULTS.n_samples)
+    prob.add_argument("--n-features", type=int, default=_DEFAULTS.n_features)
+    prob.add_argument("--n-informative-features", type=int,
+                      default=_DEFAULTS.n_informative_features)
+    prob.add_argument("--classification-sep", type=float,
+                      default=_DEFAULTS.classification_sep)
+    prob.add_argument("--dataset", choices=("synthetic", "digits"),
+                      default="synthetic",
+                      help="'digits' = real image features (the MNIST-features "
+                           "stretch config) instead of synthetic data")
+
+    opt = p.add_argument_group("optimization")
+    opt.add_argument("--n-iterations", type=int, default=_DEFAULTS.n_iterations)
+    opt.add_argument("--local-batch-size", type=int,
+                     default=_DEFAULTS.local_batch_size)
+    opt.add_argument("--learning-rate-eta0", type=float,
+                     default=_DEFAULTS.learning_rate_eta0)
+    opt.add_argument("--l2-lambda", type=float,
+                     default=_DEFAULTS.l2_regularization_lambda)
+    opt.add_argument("--lr-schedule", choices=("auto", "sqrt_decay", "constant"),
+                     default=_DEFAULTS.lr_schedule)
+    opt.add_argument("--admm-c", type=float, default=_DEFAULTS.admm_c)
+    opt.add_argument("--admm-rho", type=float, default=_DEFAULTS.admm_rho)
+    opt.add_argument("--erdos-renyi-p", type=float,
+                     default=_DEFAULTS.erdos_renyi_p)
+    opt.add_argument("--seed", type=int, default=_DEFAULTS.seed)
+    opt.add_argument("--suboptimality-threshold", type=float,
+                     default=_DEFAULTS.suboptimality_threshold)
+
+    execg = p.add_argument_group("execution")
+    execg.add_argument("--eval-every", type=int, default=_DEFAULTS.eval_every,
+                       help="full-data objective eval cadence (1 = reference "
+                            "parity)")
+    execg.add_argument("--mixing-impl",
+                       choices=("auto", "dense", "stencil", "shard_map"),
+                       default=_DEFAULTS.mixing_impl)
+    execg.add_argument("--dtype", choices=("float32", "float64", "bfloat16"),
+                       default=_DEFAULTS.dtype)
+    execg.add_argument("--matmul-precision",
+                       choices=("default", "high", "highest"),
+                       default=_DEFAULTS.matmul_precision)
+
+    out = p.add_argument_group("output")
+    out.add_argument("--plot", metavar="PATH", default=None,
+                     help="save the 2-panel log-scale figure to PATH")
+    out.add_argument("--json", metavar="PATH", default=None,
+                     help="dump all run histories + summaries as JSON")
+    out.add_argument("--quiet", action="store_true")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
+    return ExperimentConfig(
+        n_workers=args.n_workers,
+        local_batch_size=args.local_batch_size,
+        n_iterations=args.n_iterations,
+        learning_rate_eta0=args.learning_rate_eta0,
+        l2_regularization_lambda=args.l2_lambda,
+        strong_convexity_mu=args.l2_lambda,
+        problem_type=args.problem_type,
+        n_samples=args.n_samples,
+        n_features=args.n_features,
+        n_informative_features=args.n_informative_features,
+        classification_sep=args.classification_sep,
+        suboptimality_threshold=args.suboptimality_threshold,
+        backend=args.backend,
+        algorithm=args.algorithm,
+        topology=args.topology,
+        lr_schedule=args.lr_schedule,
+        admm_c=args.admm_c,
+        admm_rho=args.admm_rho,
+        seed=args.seed,
+        eval_every=args.eval_every,
+        erdos_renyi_p=args.erdos_renyi_p,
+        mixing_impl=args.mixing_impl,
+        dtype=args.dtype,
+        matmul_precision=args.matmul_precision,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.platform != "auto":
+        # Must run before any jax operation; overrides the TPU plugin's pin
+        # (and for 'tpu' fails fast if no TPU platform can initialize,
+        # instead of silently benchmarking on a CPU fallback).
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    # Grid in the suite is skipped gracefully for non-square N, but a single
+    # run with an invalid combination should fail fast in config validation.
+    if args.suite and args.topology == "grid":
+        args.topology = _DEFAULTS.topology
+
+    config = config_from_args(args)
+
+    from distributed_optimization_tpu.simulator import Simulator
+
+    dataset = None
+    if args.dataset == "digits":
+        from distributed_optimization_tpu.utils.data import generate_digits_dataset
+
+        dataset = generate_digits_dataset(config)
+
+    sim = Simulator(config, dataset=dataset)
+    if args.suite:
+        sim.run_all(verbose=not args.quiet)
+    else:
+        sim.run_one(verbose=not args.quiet)
+
+    sim.report_numerical_results()
+    if args.plot:
+        sim.plot_results(path=args.plot)
+        if not args.quiet:
+            print(f"[cli] figure saved to {args.plot}", file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(sim.results_dict(), f, indent=1)
+        if not args.quiet:
+            print(f"[cli] results saved to {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

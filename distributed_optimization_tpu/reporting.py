@@ -1,0 +1,119 @@
+"""Text report and figure generation.
+
+Capability parity with the reference's reporting layer (reference
+``simulator.py:139-201``): a numerical-results table (iterations to the
+suboptimality threshold, total and per-worker floats transmitted) and a
+2-panel log-scale matplotlib figure (suboptimality gap, consensus error)
+with the same defensive guards — skip non-finite histories, tolerate runs
+that recorded no consensus error. New columns the reference prints elsewhere
+or not at all: spectral gap (reference prints it at trainer construction,
+``trainer.py:133-135``) and measured iterations/second (the TPU-side
+observability metric).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _fmt_sci(v: float) -> str:
+    return f"{v:.3e}"
+
+
+def format_report(records, config, f_opt: float) -> str:
+    """Render the numerical-results table for a list of ExperimentRecords."""
+    lines = [
+        "=" * 78,
+        f"Numerical results — problem={config.problem_type}, N={config.n_workers}, "
+        f"T={config.n_iterations}, b={config.local_batch_size}, "
+        f"eta0={config.learning_rate_eta0}, lambda={config.l2_regularization_lambda}",
+        f"backend={config.backend}; f(x*) = {f_opt:.6f}; "
+        f"suboptimality threshold = {config.suboptimality_threshold}",
+        "=" * 78,
+    ]
+    header = (
+        f"{'run':<28}{'iters→ε':>9}{'floats total':>14}{'floats/worker':>15}"
+        f"{'1−ρ':>8}{'iters/s':>10}"
+    )
+    lines += [header, "-" * len(header)]
+    for rec in records:
+        if rec.skipped_reason is not None:
+            lines.append(f"{rec.label:<28}{'N/A — ' + rec.skipped_reason}")
+            continue
+        s = rec.summary
+        iters = str(s.iterations_to_threshold) if s.iterations_to_threshold > 0 else "never"
+        gap = f"{s.spectral_gap:.4f}" if s.spectral_gap is not None else "—"
+        lines.append(
+            f"{rec.label:<28}{iters:>9}{_fmt_sci(s.total_transmission_floats):>14}"
+            f"{_fmt_sci(s.avg_worker_transmission_floats):>15}{gap:>8}"
+            f"{s.iters_per_second:>10.1f}"
+        )
+    lines.append("=" * 78)
+    return "\n".join(lines)
+
+
+def _finite_curve(iters: np.ndarray, values: Optional[np.ndarray]):
+    """Return (iters, values) restricted to finite, positive entries, or None.
+
+    Mirrors the reference's pre-plot guards (``simulator.py:178-188``): a
+    curve with no finite data is skipped rather than crashing the figure.
+    """
+    if values is None or len(values) == 0 or len(values) != len(iters):
+        return None
+    mask = np.isfinite(values)
+    if not mask.any():
+        return None
+    return iters[mask], values[mask]
+
+
+def plot_histories(records, config, path: Optional[str] = None, show: bool = False):
+    """2-panel log-scale figure: suboptimality gap + consensus error.
+
+    Saves to ``path`` when given (headless-friendly); returns the Figure.
+    """
+    import matplotlib
+
+    if not show:
+        matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    fig, (ax_gap, ax_cons) = plt.subplots(1, 2, figsize=(13, 5))
+
+    for rec in records:
+        if rec.skipped_reason is not None or rec.result is None:
+            continue
+        hist = rec.result.history
+        curve = _finite_curve(hist.eval_iterations, hist.objective)
+        if curve is not None:
+            ax_gap.plot(curve[0], np.maximum(curve[1], 1e-16), label=rec.label)
+        curve = _finite_curve(hist.eval_iterations, hist.consensus_error)
+        if curve is not None:
+            ax_cons.plot(curve[0], np.maximum(curve[1], 1e-16), label=rec.label)
+
+    ax_gap.axhline(
+        config.suboptimality_threshold, color="gray", ls="--", lw=0.8,
+        label=f"ε = {config.suboptimality_threshold}",
+    )
+    ax_gap.set_yscale("log")
+    ax_gap.set_xlabel("iteration")
+    ax_gap.set_ylabel("f(x̄) − f(x*)")
+    ax_gap.set_title(f"Suboptimality gap ({config.problem_type})")
+    ax_gap.legend(fontsize=8)
+    ax_gap.grid(True, which="both", alpha=0.3)
+
+    ax_cons.set_yscale("log")
+    ax_cons.set_xlabel("iteration")
+    ax_cons.set_ylabel("(1/N) Σ ‖x_i − x̄‖²")
+    ax_cons.set_title("Consensus error")
+    if ax_cons.lines:
+        ax_cons.legend(fontsize=8)
+    ax_cons.grid(True, which="both", alpha=0.3)
+
+    fig.tight_layout()
+    if path is not None:
+        fig.savefig(path, dpi=130)
+    if show:
+        plt.show()
+    return fig

@@ -1,0 +1,203 @@
+"""Experiment orchestration: data → oracle → run matrix → report/plots.
+
+Capability parity with the reference's ``Simulator`` (reference
+``simulator.py:12-201``): generate the dataset once, compute the sklearn
+reference optimum, run the experiment matrix (centralized SGD + D-SGD over
+ring / toroidal grid / fully-connected, the grid skipped with an N/A record
+when N is not a perfect square — reference ``simulator.py:113-125``), record
+numerical results after each run, and emit the text report and the 2-panel
+log-scale figure.
+
+Differences by design (TPU-first):
+
+- trainers are replaced by pure-step-rule algorithms dispatched through the
+  backend layer (``backends.run_algorithm``), so the same matrix runs on the
+  JAX/TPU path or the numpy fidelity oracle via ``config.backend``;
+- the run matrix is open: any (algorithm, topology) pair the framework
+  implements can be added via ``run_one`` / ``run_suite``, not just the
+  reference's four rows;
+- workers are not stateful objects, so there is no ``_reset_workers`` trap
+  (reference ``simulator.py:29-30``) — every run starts from fresh zero
+  models by construction;
+- plots are saved to a file (headless TPU hosts) instead of ``plt.show()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import sys
+from typing import Optional
+
+import numpy as np
+
+from distributed_optimization_tpu.backends.base import (
+    BackendRunResult,
+    run_algorithm,
+)
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.metrics import NumericalResult, summarize_run
+from distributed_optimization_tpu.utils.data import (
+    HostDataset,
+    generate_synthetic_dataset,
+)
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+# The reference's experiment matrix (simulator.py:99-132): algorithm,
+# topology (None = centralized), display label.
+REFERENCE_MATRIX = (
+    ("centralized", None, "Centralized SGD"),
+    ("dsgd", "ring", "D-SGD (ring)"),
+    ("dsgd", "grid", "D-SGD (grid)"),
+    ("dsgd", "fully_connected", "D-SGD (fully connected)"),
+)
+
+
+@dataclasses.dataclass
+class ExperimentRecord:
+    """One completed (or skipped) run of the matrix."""
+
+    label: str
+    config: Optional[ExperimentConfig]  # None for skipped rows
+    result: Optional[BackendRunResult]
+    summary: Optional[NumericalResult]
+    skipped_reason: Optional[str] = None
+
+
+class Simulator:
+    """Runs experiments against one shared dataset + reference optimum.
+
+    ``base_config`` fixes the problem, data, and solver hyperparameters;
+    per-run calls may override algorithm/topology/backend. Data and f(x*)
+    are computed once so every run is measured against the same ground truth
+    (reference ``simulator.py:15-18``).
+    """
+
+    def __init__(
+        self, base_config: ExperimentConfig, dataset: Optional[HostDataset] = None
+    ):
+        self.config = base_config
+        self.dataset = (
+            dataset if dataset is not None else generate_synthetic_dataset(base_config)
+        )
+        self.w_opt, self.f_opt = compute_reference_optimum(
+            self.dataset, base_config.reg_param
+        )
+        self.records: list[ExperimentRecord] = []
+
+    # ------------------------------------------------------------------ runs
+    def run_one(
+        self, label: Optional[str] = None, *, verbose: bool = True, **overrides
+    ) -> ExperimentRecord:
+        """Run one experiment; ``overrides`` replace base-config fields."""
+        cfg = self.config.replace(**overrides) if overrides else self.config
+        if label is None:
+            label = (
+                "Centralized SGD"
+                if cfg.algorithm == "centralized"
+                else f"{cfg.algorithm} ({cfg.topology})"
+            )
+        if verbose:
+            print(f"[simulator] running {label!r} "
+                  f"(algorithm={cfg.algorithm}, topology={cfg.topology}, "
+                  f"backend={cfg.backend}, T={cfg.n_iterations})", file=sys.stderr)
+        result = run_algorithm(cfg, self.dataset, self.f_opt)
+        summary = summarize_run(
+            label,
+            result.history,
+            cfg.suboptimality_threshold,
+            cfg.n_workers,
+            spectral_gap=result.history.spectral_gap,
+        )
+        record = ExperimentRecord(label, cfg, result, summary)
+        self.records.append(record)
+        if verbose:
+            gap = result.history.objective[-1]
+            print(
+                f"[simulator] {label!r}: final gap {gap:.5f}, "
+                f"iters-to-threshold {summary.iterations_to_threshold}, "
+                f"{result.history.iters_per_second:.1f} iters/sec",
+                file=sys.stderr,
+            )
+        return record
+
+    def skip(self, label: str, reason: str) -> ExperimentRecord:
+        record = ExperimentRecord(label, None, None, None, skipped_reason=reason)
+        self.records.append(record)
+        return record
+
+    def run_all(self, *, verbose: bool = True) -> list[ExperimentRecord]:
+        """Run the reference's four-row experiment matrix.
+
+        Grid is skipped with an N/A record when N is not a perfect square
+        (reference ``simulator.py:113-125``).
+        """
+        n = self.config.n_workers
+        side = math.isqrt(n)
+        for algorithm, topology, label in REFERENCE_MATRIX:
+            if topology == "grid" and side * side != n:
+                self.skip(label, f"N={n} is not a perfect square")
+                continue
+            overrides = {"algorithm": algorithm}
+            if topology is not None:
+                overrides["topology"] = topology
+            self.run_one(label, verbose=verbose, **overrides)
+        return self.records
+
+    def run_suite(
+        self, specs: list[tuple[str, Optional[str]]], *, verbose: bool = True
+    ) -> list[ExperimentRecord]:
+        """Run an arbitrary list of (algorithm, topology-or-None) pairs."""
+        for algorithm, topology in specs:
+            overrides = {"algorithm": algorithm}
+            if topology is not None:
+                overrides["topology"] = topology
+            self.run_one(verbose=verbose, **overrides)
+        return self.records
+
+    # -------------------------------------------------------------- reporting
+    def report_numerical_results(self) -> str:
+        """Text report (reference ``simulator.py:139-159``); also returned."""
+        from distributed_optimization_tpu.reporting import format_report
+
+        text = format_report(self.records, self.config, self.f_opt)
+        print(text)
+        return text
+
+    def plot_results(self, path: Optional[str] = None, show: bool = False):
+        """Two-panel log-scale figure (reference ``simulator.py:161-201``)."""
+        from distributed_optimization_tpu.reporting import plot_histories
+
+        return plot_histories(
+            self.records,
+            self.config,
+            path=path,
+            show=show,
+        )
+
+    def results_dict(self) -> dict:
+        """JSON-serializable summary of all runs (new capability)."""
+        out = {
+            "config": self.config.to_dict(),
+            "f_opt": float(self.f_opt),
+            "runs": [],
+        }
+        for rec in self.records:
+            row: dict = {"label": rec.label}
+            if rec.skipped_reason is not None:
+                row["skipped"] = rec.skipped_reason
+            else:
+                assert rec.summary is not None and rec.result is not None
+                row.update(
+                    iterations_to_threshold=rec.summary.iterations_to_threshold,
+                    total_transmission_floats=rec.summary.total_transmission_floats,
+                    avg_worker_transmission_floats=(
+                        rec.summary.avg_worker_transmission_floats
+                    ),
+                    spectral_gap=rec.summary.spectral_gap,
+                    iters_per_second=rec.summary.iters_per_second,
+                    final_objective_gap=float(rec.result.history.objective[-1]),
+                    history=rec.result.history.as_dict(),
+                )
+            out["runs"].append(row)
+        return out

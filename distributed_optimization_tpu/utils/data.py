@@ -113,6 +113,40 @@ def generate_synthetic_dataset(config) -> HostDataset:
     )
 
 
+def generate_digits_dataset(config) -> HostDataset:
+    """Real image-feature dataset (the BASELINE.json "MNIST features" stretch
+    config, offline-friendly): sklearn's bundled 8×8 digits (1,797 samples,
+    64 pixel features) instead of synthetic data.
+
+    Same preprocessing pipeline as the synthetic path: StandardScaler, bias
+    column, sorted-by-target non-IID partition. For ``logistic`` the labels
+    are binarized to ±1 (digit ≥ 5); for ``quadratic`` the digit value is the
+    regression target. ``config.n_samples`` caps the sample count;
+    ``n_features`` is ignored (the data has 64).
+    """
+    from sklearn.datasets import load_digits
+    from sklearn.preprocessing import StandardScaler
+
+    X, digit = load_digits(return_X_y=True)
+    n = min(config.n_samples, X.shape[0])
+    X, digit = X[:n], digit[:n]
+    if config.problem_type == "logistic":
+        y = np.where(digit >= 5, 1.0, -1.0)
+    else:
+        y = digit.astype(np.float64)
+
+    X = StandardScaler().fit_transform(X)
+    # Constant pixels scale to 0/0; StandardScaler leaves them 0 — fine.
+    X = np.hstack([X, np.ones((X.shape[0], 1))])
+
+    order = np.argsort(y, kind="stable")
+    shard_indices = [np.asarray(s) for s in np.array_split(order, config.n_workers)]
+    return HostDataset(
+        X_full=X, y_full=y, shard_indices=shard_indices,
+        problem_type=config.problem_type,
+    )
+
+
 def stack_shards(dataset: HostDataset, dtype=np.float32) -> DeviceDataset:
     """Stack ragged shards into padded [N, L, d] arrays for the device path."""
     n = dataset.n_workers

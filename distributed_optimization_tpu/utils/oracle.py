@@ -19,7 +19,7 @@ from distributed_optimization_tpu.utils.data import HostDataset
 
 
 def compute_reference_optimum(
-    dataset: HostDataset, reg_param: float, *, max_iter: int = 5000, tol: float = 1e-9
+    dataset: HostDataset, reg_param: float, *, max_iter: int = 50_000, tol: float = 1e-9
 ) -> tuple[np.ndarray, float]:
     """Return (w_opt [d], f_opt) for the dataset's problem type."""
     from sklearn.linear_model import LogisticRegression, Ridge

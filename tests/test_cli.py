@@ -1,0 +1,62 @@
+"""CLI tests: flag parsing -> config, end-to-end runs, outputs."""
+
+import json
+
+from distributed_optimization_tpu.cli import build_parser, config_from_args, main
+
+
+def test_defaults_match_reference_config():
+    args = build_parser().parse_args([])
+    cfg = config_from_args(args)
+    # Reference main.py:6-21 defaults.
+    assert cfg.n_workers == 25
+    assert cfg.n_iterations == 10_000
+    assert cfg.local_batch_size == 16
+    assert cfg.learning_rate_eta0 == 0.05
+    assert cfg.l2_regularization_lambda == 1e-4
+    assert cfg.seed == 203
+
+
+def test_flag_round_trip():
+    args = build_parser().parse_args(
+        ["--algorithm", "extra", "--topology", "grid", "--n-workers", "16",
+         "--backend", "numpy", "--dtype", "float64", "--eval-every", "5",
+         "--n-iterations", "100"]
+    )
+    cfg = config_from_args(args)
+    assert (cfg.algorithm, cfg.topology, cfg.n_workers) == ("extra", "grid", 16)
+    assert (cfg.backend, cfg.dtype, cfg.eval_every) == ("numpy", "float64", 5)
+
+
+_TINY = [
+    "--n-workers", "9", "--n-samples", "360", "--n-features", "8",
+    "--n-informative-features", "4", "--n-iterations", "30",
+    "--problem-type", "quadratic", "--quiet",
+]
+
+
+def test_main_single_run(tmp_path, capsys):
+    json_out = tmp_path / "r.json"
+    rc = main(_TINY + ["--algorithm", "dsgd", "--topology", "ring",
+                       "--json", str(json_out)])
+    assert rc == 0
+    assert "D-SGD" not in capsys.readouterr().err  # quiet
+    blob = json.loads(json_out.read_text())
+    assert len(blob["runs"]) == 1
+
+
+def test_main_suite_with_plot(tmp_path):
+    plot = tmp_path / "fig.png"
+    rc = main(_TINY + ["--suite", "--plot", str(plot)])
+    assert rc == 0
+    assert plot.exists() and plot.stat().st_size > 0
+
+
+def test_main_digits_dataset(tmp_path):
+    json_out = tmp_path / "d.json"
+    rc = main(["--dataset", "digits", "--problem-type", "logistic",
+               "--n-workers", "8", "--n-samples", "500", "--n-iterations", "20",
+               "--quiet", "--json", str(json_out)])
+    assert rc == 0
+    blob = json.loads(json_out.read_text())
+    assert blob["runs"][0]["history"]["objective"]

@@ -1,0 +1,124 @@
+"""Orchestration-layer tests: Simulator run matrix, reporting, plotting.
+
+Mirrors the reference's Simulator semantics (SURVEY.md C2/C9): shared dataset
++ reference optimum across runs, the four-row experiment matrix with the grid
+skipped for non-square N, text report, and figure generation.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.simulator import Simulator
+
+TINY = ExperimentConfig(
+    n_workers=9,
+    n_samples=360,
+    n_features=10,
+    n_informative_features=6,
+    n_iterations=40,
+    local_batch_size=8,
+    problem_type="quadratic",
+    suboptimality_threshold=1e9,  # reached immediately -> deterministic rows
+)
+
+
+@pytest.fixture(scope="module")
+def sim():
+    s = Simulator(TINY)
+    s.run_all(verbose=False)
+    return s
+
+
+def test_run_all_covers_reference_matrix(sim):
+    labels = [r.label for r in sim.records]
+    assert labels == [
+        "Centralized SGD",
+        "D-SGD (ring)",
+        "D-SGD (grid)",
+        "D-SGD (fully connected)",
+    ]
+    assert all(r.skipped_reason is None for r in sim.records)
+    for rec in sim.records:
+        assert np.all(np.isfinite(rec.result.history.objective))
+        assert rec.summary.iterations_to_threshold == 1  # threshold huge
+
+
+def test_grid_skipped_for_nonsquare_n():
+    s = Simulator(TINY.replace(n_workers=10, n_samples=400))
+    s.run_all(verbose=False)
+    grid = [r for r in s.records if "grid" in r.label][0]
+    assert grid.skipped_reason is not None
+    assert grid.result is None
+    done = [r for r in s.records if r.skipped_reason is None]
+    assert len(done) == 3
+
+
+def test_shared_dataset_and_optimum(sim):
+    # All runs measure against one f_opt on one dataset (reference
+    # simulator.py:15-18): fresh zero-init per run, same ground truth.
+    assert np.isfinite(sim.f_opt)
+    gaps = [rec.result.history.objective[0] for rec in sim.records]
+    # First-iteration gaps are close across runs (same data, same x0=0).
+    assert np.std(gaps) / np.abs(np.mean(gaps)) < 0.2
+
+
+def test_report_contains_all_rows(sim, capsys):
+    text = sim.report_numerical_results()
+    capsys.readouterr()
+    for rec in sim.records:
+        assert rec.label in text
+    assert "floats/worker" in text
+
+
+def test_float_accounting_matches_closed_forms(sim):
+    # 2NdT centralized; Sum(deg)·d·T decentralized (reference trainer.py
+    # counting; BASELINE.md closed forms). d = n_features + 1 bias.
+    d = TINY.n_features + 1
+    n, T = TINY.n_workers, TINY.n_iterations
+    by_label = {r.label: r.summary.total_transmission_floats for r in sim.records}
+    assert by_label["Centralized SGD"] == 2 * n * d * T
+    assert by_label["D-SGD (ring)"] == 2 * n * d * T  # ring degree 2
+    assert by_label["D-SGD (grid)"] == 4 * n * d * T  # torus degree 4
+    assert by_label["D-SGD (fully connected)"] == (n - 1) * n * d * T
+
+
+def test_plot_results_saves_figure(sim, tmp_path):
+    out = tmp_path / "fig.png"
+    fig = sim.plot_results(path=str(out))
+    assert out.exists() and out.stat().st_size > 0
+    # Both panels drew: 4 gap curves + threshold line; 3 consensus curves.
+    axes = fig.get_axes()
+    assert len(axes[0].lines) == 5
+    assert len(axes[1].lines) == 3
+
+
+def test_results_dict_is_json_serializable(sim):
+    blob = json.dumps(sim.results_dict())
+    parsed = json.loads(blob)
+    assert parsed["config"]["n_workers"] == TINY.n_workers
+    assert len(parsed["runs"]) == 4
+    assert "history" in parsed["runs"][0]
+
+
+def test_numpy_backend_matrix():
+    s = Simulator(TINY.replace(backend="numpy", n_iterations=20))
+    s.run_all(verbose=False)
+    assert all(r.skipped_reason is None for r in s.records)
+    for rec in s.records:
+        assert np.all(np.isfinite(rec.result.history.objective))
+
+
+def test_run_suite_extended_algorithms():
+    s = Simulator(TINY.replace(n_iterations=30, lr_schedule="constant",
+                               learning_rate_eta0=0.01))
+    s.run_suite(
+        [("gradient_tracking", "ring"), ("extra", "ring"),
+         ("admm", "erdos_renyi")],
+        verbose=False,
+    )
+    assert len(s.records) == 3
+    for rec in s.records:
+        assert np.all(np.isfinite(rec.result.final_models))
