@@ -57,5 +57,14 @@ def _step(state: State, ctx: StepContext) -> State:
 
 
 ADMM = register_algorithm(
-    Algorithm(name="admm", init=_init, step=_step, gossip_rounds=1)
+    Algorithm(
+        name="admm",
+        init=_init,
+        step=_step,
+        gossip_rounds=1,
+        # The dual update pairs neighbor_sum with the STATIC degree d_i; a
+        # dropped edge would inject a spurious (c/2)·x_i into alpha each
+        # iteration and shift the fixed point.
+        supports_edge_faults=False,
+    )
 )

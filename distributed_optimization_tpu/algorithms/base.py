@@ -72,6 +72,12 @@ class Algorithm:
     step: Callable[[State, StepContext], State]
     gossip_rounds: int = 1
     is_decentralized: bool = True
+    # Whether the step rule stays correct when the graph varies over time
+    # (edge-failure injection). True for mix-based rules — any doubly
+    # stochastic W_t preserves the average. False for rules that combine
+    # ``neighbor_sum`` with static degree constants (ADMM's dual update),
+    # which a dropped edge would bias.
+    supports_edge_faults: bool = True
 
 
 _REGISTRY: dict[str, Algorithm] = {}

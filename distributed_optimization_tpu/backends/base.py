@@ -41,4 +41,8 @@ def run_algorithm(config, dataset, f_opt, **kwargs) -> BackendRunResult:
         from distributed_optimization_tpu.backends import numpy_backend
 
         return numpy_backend.run(config, dataset, f_opt, **kwargs)
+    if config.backend == "cpp":
+        from distributed_optimization_tpu.backends import cpp_backend
+
+        return cpp_backend.run(config, dataset, f_opt, **kwargs)
     raise ValueError(f"Unknown backend: {config.backend!r}")

@@ -38,6 +38,7 @@ from distributed_optimization_tpu.metrics import (
 from distributed_optimization_tpu.models import get_problem
 from distributed_optimization_tpu.ops.mixing import make_mixing_op
 from distributed_optimization_tpu.ops.sampling import sample_worker_batches
+from distributed_optimization_tpu.parallel.faults import make_faulty_mixing
 from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.parallel.collectives import make_shard_map_mixing_op
 from distributed_optimization_tpu.parallel.mesh import (
@@ -78,6 +79,84 @@ def _make_eta_fn(config):
     return lambda t: jnp.asarray(eta0)
 
 
+def _run_checkpointed(
+    chunk, state0, checkpoint, mesh, config, n_evals, measure_compile,
+):
+    """Host-driven chunk loop with periodic orbax saves and resume.
+
+    One 'chunk' = ``eval_every`` fused iterations (the same compiled body the
+    single-scan path uses); the host only intervenes at eval boundaries, so
+    steady-state throughput matches the fused path up to one host sync per
+    ``eval_every`` iterations. Returns (final_state, gap_hist, cons_hist,
+    realized_floats, executed_iters, compile_seconds, run_seconds) —
+    ``executed_iters`` counts only iterations run in THIS process, so resumed
+    runs report honest throughput.
+    """
+    from distributed_optimization_tpu.parallel.mesh import (
+        replicate as _replicate,
+        shard_over_workers as _shard,
+    )
+    from distributed_optimization_tpu.utils.checkpoint import RunCheckpointer
+
+    eval_every = config.eval_every
+    ckptr = RunCheckpointer(checkpoint)
+    ckptr.validate_or_record_config(config)
+    ts_row0 = _replicate(mesh, jnp.arange(eval_every, dtype=jnp.int32))
+
+    t0 = time.perf_counter()
+    with jax.default_matmul_precision(config.matmul_precision):
+        compiled = jax.jit(chunk).lower(state0, ts_row0).compile()
+    compile_seconds = time.perf_counter() - t0 if measure_compile else 0.0
+
+    state = state0
+    gap_list: list[float] = []
+    cons_list: list[float] = []
+    floats_list: list[float] = []
+    start_chunk = 0
+    if checkpoint.resume:
+        restored = ckptr.restore()
+        if restored is not None:
+            state_np, gaps, conss, floats, start_chunk = restored
+            if start_chunk > n_evals:
+                raise ValueError(
+                    f"checkpoint at chunk {start_chunk} exceeds this run's "
+                    f"horizon of {n_evals} chunks (n_iterations shrank below "
+                    "the checkpointed progress)"
+                )
+            state = _shard(mesh, jax.tree.map(np.asarray, state_np))
+            gap_list = [float(v) for v in gaps]
+            cons_list = [float(v) for v in conss]
+            floats_list = [float(v) for v in floats]
+
+    t1 = time.perf_counter()
+    for c in range(start_chunk, n_evals):
+        ts = _replicate(
+            mesh,
+            jnp.arange(c * eval_every, (c + 1) * eval_every, dtype=jnp.int32),
+        )
+        state, out = compiled(state, ts)
+        if "gap" in out:
+            gap_list.append(float(out["gap"]))
+        if "cons" in out:
+            cons_list.append(float(out["cons"]))
+        if "floats" in out:
+            floats_list.append(float(out["floats"]))
+        done = c + 1
+        if done % checkpoint.every_evals == 0 or done == n_evals:
+            ckptr.save(
+                done, jax.device_get(state), gap_list, cons_list, floats_list
+            )
+    state = jax.block_until_ready(state)
+    run_seconds = time.perf_counter() - t1
+
+    gap_hist = np.asarray(gap_list, dtype=np.float64)
+    cons_hist = np.asarray(cons_list, dtype=np.float64) if cons_list else None
+    realized_floats = float(np.sum(floats_list)) if floats_list else None
+    executed_iters = (n_evals - start_chunk) * eval_every
+    return (state, gap_hist, cons_hist, realized_floats, executed_iters,
+            compile_seconds, run_seconds)
+
+
 def run(
     config,
     dataset: HostDataset,
@@ -88,13 +167,17 @@ def run(
     batch_schedule: Optional[np.ndarray] = None,
     collect_metrics: bool = True,
     measure_compile: bool = True,
+    checkpoint=None,
 ) -> BackendRunResult:
     """Run one experiment on the JAX backend; returns histories + final models.
 
     ``mesh``: an explicit ``jax.sharding.Mesh`` (1-D, axis 'workers');
     ``use_mesh=True`` builds one over all visible devices that evenly divide
     N. ``batch_schedule [T, N, b]`` injects fixed batch indices (equivalence
-    testing vs the numpy oracle — SURVEY.md §4c).
+    testing vs the numpy oracle — SURVEY.md §4c). ``checkpoint``: a
+    ``utils.checkpoint.CheckpointOptions``; when given, the run executes as a
+    host-driven loop over compiled eval-chunks with periodic orbax saves (and
+    resume), instead of one fully fused scan.
     """
     algo = get_algorithm(config.algorithm)
     problem = get_problem(config.problem_type)
@@ -129,9 +212,35 @@ def run(
             topo, device_data.n_features, algo.gossip_rounds
         )
         spectral_gap = topo.spectral_gap
+        if config.edge_drop_prob > 0.0:
+            if config.mixing_impl == "shard_map":
+                raise ValueError(
+                    "edge_drop_prob requires dense/stencil mixing: the "
+                    "shard_map stencils assume the static uniform-weight "
+                    "topology (use mixing_impl='dense' for fault injection)"
+                )
+            if not algo.supports_edge_faults:
+                raise ValueError(
+                    f"edge_drop_prob is unsupported for {algo.name!r}: its "
+                    "update combines neighbor sums with static degree "
+                    "constants, which dropped edges would bias"
+                )
+            faulty = make_faulty_mixing(
+                topo, config.edge_drop_prob, config.seed,
+                dtype=device_data.X.dtype,
+            )
+        else:
+            faulty = None
     else:
+        if config.edge_drop_prob > 0.0:
+            raise ValueError(
+                "edge_drop_prob models gossip-link failures and applies only "
+                "to decentralized algorithms; the centralized pattern has no "
+                "peer edges to drop"
+            )
         topo = None
         mix_op = None
+        faulty = None
         degrees = jnp.zeros((n, 1), dtype=device_data.X.dtype)
         floats_per_iter = centralized_floats_per_iteration(n, device_data.n_features)
         spectral_gap = None
@@ -183,12 +292,17 @@ def run(
     eval_every = config.eval_every
 
     def step(state, t):
+        if faulty is not None:
+            mix_fn = lambda v: faulty.mix(t, v)  # noqa: E731
+            nbr_fn = lambda v: faulty.neighbor_sum(t, v)  # noqa: E731
+        elif mix_op is not None:
+            mix_fn, nbr_fn = mix_op.apply, mix_op.neighbor_sum
+        else:
+            mix_fn, nbr_fn = (lambda v: v), (lambda v: v * 0)
         ctx = StepContext(
             grad=grad_fn_factory(t),
-            mix=mix_op.apply if mix_op is not None else (lambda v: v),
-            neighbor_sum=(
-                mix_op.neighbor_sum if mix_op is not None else (lambda v: v * 0)
-            ),
+            mix=mix_fn,
+            neighbor_sum=nbr_fn,
             eta=eta_fn(t),
             t=t,
             degrees=degrees,
@@ -202,41 +316,70 @@ def run(
         # calls for (the reference evaluates every iteration; k=1 reproduces
         # that exactly).
         state, _ = jax.lax.scan(step, state, ts)
-        out = ()
+        out = {}
         if collect_metrics:
             x = state["x"]
             xbar = jnp.mean(x, axis=0)
-            out = (full_objective(xbar) - f_opt,)
+            out["gap"] = full_objective(xbar) - f_opt
             if track_consensus:
-                out += (jnp.mean(jnp.sum((x - xbar[None, :]) ** 2, axis=1)),)
+                out["cons"] = jnp.mean(jnp.sum((x - xbar[None, :]) ** 2, axis=1))
+        if faulty is not None:
+            # Honest comms accounting under faults: floats actually exchanged
+            # over realized edges this chunk (recomputed from the fault keys,
+            # so it costs one tiny mask redraw per iteration, no extra
+            # communication).
+            out["floats"] = (
+                jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts))
+                * device_data.n_features
+                * algo.gossip_rounds
+            )
         return state, out
 
-    def run_scan(state_init):
-        ts = jnp.arange(T, dtype=jnp.int32).reshape(T // eval_every, eval_every)
-        return jax.lax.scan(chunk, state_init, ts)
-
-    # AOT compile so compile time and steady-state execution are separable
-    # (jax.profiler-style phase split, SURVEY.md §5.1).
-    t0 = time.perf_counter()
-    with jax.default_matmul_precision(config.matmul_precision):
-        compiled = jax.jit(run_scan).lower(state0).compile()
-    compile_seconds = time.perf_counter() - t0 if measure_compile else 0.0
-
-    t1 = time.perf_counter()
-    final_state, ys = compiled(state0)
-    final_state = jax.block_until_ready(final_state)
-    run_seconds = time.perf_counter() - t1
-
-    final_models = np.asarray(final_state["x"], dtype=np.float64)
     n_evals = T // eval_every
-    if collect_metrics:
-        gap_hist = np.asarray(ys[0], dtype=np.float64)
+
+    if checkpoint is None:
+        def run_scan(state_init):
+            ts = jnp.arange(T, dtype=jnp.int32).reshape(n_evals, eval_every)
+            return jax.lax.scan(chunk, state_init, ts)
+
+        # AOT compile so compile time and steady-state execution are separable
+        # (jax.profiler-style phase split, SURVEY.md §5.1).
+        t0 = time.perf_counter()
+        with jax.default_matmul_precision(config.matmul_precision):
+            compiled = jax.jit(run_scan).lower(state0).compile()
+        compile_seconds = time.perf_counter() - t0 if measure_compile else 0.0
+
+        t1 = time.perf_counter()
+        final_state, ys = compiled(state0)
+        final_state = jax.block_until_ready(final_state)
+        run_seconds = time.perf_counter() - t1
+        executed_iters = T
+
+        gap_hist = (
+            np.asarray(ys["gap"], dtype=np.float64)
+            if "gap" in ys else np.full(n_evals, np.nan)
+        )
         cons_hist = (
-            np.asarray(ys[1], dtype=np.float64) if track_consensus else None
+            np.asarray(ys["cons"], dtype=np.float64) if "cons" in ys else None
+        )
+        realized_floats = (
+            float(np.sum(np.asarray(ys["floats"], dtype=np.float64)))
+            if "floats" in ys else None
         )
     else:
-        gap_hist = np.full(n_evals, np.nan)
-        cons_hist = None
+        (final_state, gap_hist, cons_hist, realized_floats, executed_iters,
+         compile_seconds, run_seconds) = _run_checkpointed(
+            chunk, state0, checkpoint, mesh, config, n_evals, measure_compile,
+        )
+        if not collect_metrics:
+            gap_hist = np.full(n_evals, np.nan)
+        if not track_consensus:
+            cons_hist = None
+
+    total_floats = (
+        realized_floats if realized_floats is not None else floats_per_iter * T
+    )
+    final_models = np.asarray(final_state["x"], dtype=np.float64)
 
     history = RunHistory(
         objective=gap_hist,
@@ -246,8 +389,13 @@ def run(
         # reference's per-iter time.time() samples, trainer.py:63,181).
         time=np.linspace(run_seconds / max(n_evals, 1), run_seconds, n_evals),
         eval_iterations=np.arange(eval_every, T + 1, eval_every),
-        total_floats_transmitted=floats_per_iter * T,
-        iters_per_second=T / run_seconds if run_seconds > 0 else float("inf"),
+        total_floats_transmitted=total_floats,
+        # Throughput counts only iterations executed in THIS process, so a
+        # resumed run doesn't claim credit for checkpointed progress.
+        iters_per_second=(
+            executed_iters / run_seconds if run_seconds > 0 and executed_iters
+            else float("nan")
+        ),
         compile_seconds=compile_seconds,
         spectral_gap=spectral_gap,
     )

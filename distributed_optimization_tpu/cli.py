@@ -89,6 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
     opt.add_argument("--admm-rho", type=float, default=_DEFAULTS.admm_rho)
     opt.add_argument("--erdos-renyi-p", type=float,
                      default=_DEFAULTS.erdos_renyi_p)
+    opt.add_argument("--edge-drop-prob", type=float,
+                     default=_DEFAULTS.edge_drop_prob,
+                     help="failure injection: per-iteration probability that "
+                          "each topology edge drops (gossip reweights on the "
+                          "surviving graph)")
     opt.add_argument("--seed", type=int, default=_DEFAULTS.seed)
     opt.add_argument("--suboptimality-threshold", type=float,
                      default=_DEFAULTS.suboptimality_threshold)
@@ -105,6 +110,26 @@ def build_parser() -> argparse.ArgumentParser:
     execg.add_argument("--matmul-precision",
                        choices=("default", "high", "highest"),
                        default=_DEFAULTS.matmul_precision)
+
+    ckpt = p.add_argument_group("checkpoint / resume (jax backend)")
+    ckpt.add_argument("--checkpoint-dir", metavar="DIR", default=None,
+                      help="save orbax checkpoints under DIR during the run")
+    ckpt.add_argument("--checkpoint-every", type=int, default=10, metavar="K",
+                      help="checkpoint cadence in eval-chunks "
+                           "(K × eval_every iterations)")
+    ckpt.add_argument("--no-resume", action="store_true",
+                      help="start fresh even if DIR holds a checkpoint")
+
+    diag = p.add_argument_group("profiling / diagnostics")
+    diag.add_argument("--profile-dir", metavar="DIR", default=None,
+                      help="collect a jax.profiler (XProf/TensorBoard) trace "
+                           "of the run into DIR")
+    diag.add_argument("--check-nans", action="store_true",
+                      help="enable jax_debug_nans: raise at the first "
+                           "NaN-producing op instead of finishing with NaNs")
+    diag.add_argument("--preflight", action="store_true",
+                      help="verify mesh collectives (ppermute round-trip, "
+                           "psum identity) before running")
 
     out = p.add_argument_group("output")
     out.add_argument("--plot", metavar="PATH", default=None,
@@ -138,6 +163,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         seed=args.seed,
         eval_every=args.eval_every,
         erdos_renyi_p=args.erdos_renyi_p,
+        edge_drop_prob=args.edge_drop_prob,
         mixing_impl=args.mixing_impl,
         dtype=args.dtype,
         matmul_precision=args.matmul_precision,
@@ -170,11 +196,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         dataset = generate_digits_dataset(config)
 
+    run_kwargs = {}
+    if args.checkpoint_dir:
+        if args.backend != "jax":
+            raise SystemExit("--checkpoint-dir requires --backend jax")
+        from distributed_optimization_tpu.utils.checkpoint import CheckpointOptions
+
+        run_kwargs["checkpoint"] = CheckpointOptions(
+            directory=args.checkpoint_dir,
+            every_evals=args.checkpoint_every,
+            resume=not args.no_resume,
+        )
+
+    if args.preflight:
+        from distributed_optimization_tpu.utils.diagnostics import check_collectives
+
+        check_collectives()
+        if not args.quiet:
+            print("[cli] preflight collective checks passed", file=sys.stderr)
+
+    from distributed_optimization_tpu.utils.diagnostics import nan_debugging
+    from distributed_optimization_tpu.utils.profiling import trace
+
     sim = Simulator(config, dataset=dataset)
-    if args.suite:
-        sim.run_all(verbose=not args.quiet)
-    else:
-        sim.run_one(verbose=not args.quiet)
+    with trace(args.profile_dir), nan_debugging(args.check_nans):
+        if args.suite:
+            if run_kwargs:
+                raise SystemExit(
+                    "--checkpoint-dir applies to single runs, not --suite"
+                )
+            sim.run_all(verbose=not args.quiet)
+        else:
+            sim.run_one(verbose=not args.quiet, run_kwargs=run_kwargs)
 
     sim.report_numerical_results()
     if args.plot:

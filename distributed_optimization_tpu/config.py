@@ -23,7 +23,7 @@ TOPOLOGIES = ("ring", "grid", "fully_connected", "erdos_renyi", "chain", "star")
 
 PROBLEM_TYPES = ("logistic", "quadratic")
 
-BACKENDS = ("jax", "numpy")
+BACKENDS = ("jax", "numpy", "cpp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +64,10 @@ class ExperimentConfig:
     seed: int = 203  # reference seeds np.random.seed(203) at main.py:24
     eval_every: int = 1  # full-data objective eval cadence (reference: every iter)
     erdos_renyi_p: float = 0.4  # edge probability for the ER topology
+    # Failure injection (SURVEY.md §5.3): per-iteration iid probability that
+    # each edge of the topology drops; gossip runs over the surviving graph
+    # with MH weights recomputed on realized degrees. 0 = no faults.
+    edge_drop_prob: float = 0.0
     mixing_impl: str = "auto"  # 'auto' | 'dense' | 'stencil' | 'shard_map'
     dtype: str = "float32"
     matmul_precision: str = "highest"  # jax.lax Precision for parity-sensitive math
@@ -82,6 +86,10 @@ class ExperimentConfig:
             raise ValueError(f"Unknown mixing impl: {self.mixing_impl}")
         if self.lr_schedule not in ("auto", "sqrt_decay", "constant"):
             raise ValueError(f"Unknown lr schedule: {self.lr_schedule}")
+        if not 0.0 <= self.edge_drop_prob < 1.0:
+            raise ValueError(
+                f"edge_drop_prob must be in [0, 1), got {self.edge_drop_prob}"
+            )
         if self.dtype not in ("float32", "float64", "bfloat16"):
             raise ValueError(f"Unknown dtype: {self.dtype}")
         if self.matmul_precision not in ("default", "high", "highest"):

@@ -1,0 +1,101 @@
+"""Failure injection: time-varying gossip over randomly dropped edges.
+
+The reference has no failure model — its synchronous lockstep loop cannot
+lose a worker (SURVEY.md §5.3); its report only *discusses* the parameter
+server as a single point of failure. Here link failure is a first-class,
+jit-compatible simulation: each iteration, every edge of the base topology
+independently drops with probability ``drop_prob`` (a symmetric draw — both
+endpoints agree the link is down), and gossip runs over the surviving graph
+with Metropolis–Hastings weights recomputed on the realized degrees. This is
+the time-varying-graph setting of Koloskova et al. '20 (reference report
+ref [13]): W_t stays symmetric and doubly stochastic for every realization,
+so the network average is preserved and D-SGD/GT/EXTRA remain convergent
+under their time-varying-gossip analyses.
+
+Edge masks are derived purely from (fault key, iteration) — like batch
+sampling, fault realizations are reproducible and checkpoint/resume-safe with
+no carried RNG state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from distributed_optimization_tpu.parallel.topology import Topology
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultyMixing:
+    """Per-iteration mixing operators over a randomly failing topology.
+
+    ``mix(t, x)``: W_t x with W_t the MH matrix of the surviving graph.
+    ``neighbor_sum(t, x)``: A_t x over surviving edges.
+    ``realized_floats(t)``: floats a simulator would count as transmitted at
+    iteration t (Σ realized deg_i · d is the caller's job — this returns
+    Σ realized deg_i; multiply by d and gossip rounds downstream).
+    """
+
+    mix: Callable[[jax.Array, jax.Array], jax.Array]
+    neighbor_sum: Callable[[jax.Array, jax.Array], jax.Array]
+    realized_degree_sum: Callable[[jax.Array], jax.Array]
+    drop_prob: float
+
+
+def sample_surviving_adjacency(key, adjacency: jax.Array, drop_prob: float):
+    """Symmetric iid edge-drop mask applied to a 0/1 adjacency matrix."""
+    n = adjacency.shape[0]
+    u = jax.random.uniform(key, (n, n))
+    u = jnp.triu(u, 1)
+    u = u + u.T  # symmetric: both endpoints see the same draw
+    return jnp.where(u >= drop_prob, adjacency, jnp.zeros_like(adjacency))
+
+
+def metropolis_hastings_weights(adjacency: jax.Array) -> jax.Array:
+    """MH mixing matrix for an arbitrary 0/1 adjacency (jit-compatible).
+
+    W_ij = 1/(1 + max(d_i, d_j)) on edges, diagonal = row remainder — the
+    same rule the static topology builder uses (reference
+    ``trainer.py:118-126``), but recomputed on-device for each realization.
+    Symmetric and doubly stochastic for any undirected graph, including
+    isolated nodes (row collapses to W_ii = 1).
+    """
+    deg = jnp.sum(adjacency, axis=1)
+    pair = 1.0 / (1.0 + jnp.maximum(deg[:, None], deg[None, :]))
+    W = adjacency * pair
+    return W + jnp.diag(1.0 - jnp.sum(W, axis=1))
+
+
+def make_faulty_mixing(
+    topo: Topology, drop_prob: float, seed: int, dtype=jnp.float32
+) -> FaultyMixing:
+    """Build time-varying mixing operators for a base topology."""
+    if not 0.0 <= drop_prob < 1.0:
+        raise ValueError(f"drop_prob must be in [0, 1), got {drop_prob}")
+    base_A = jnp.asarray(topo.adjacency, dtype=dtype)
+    # Distinct stream from batch sampling: fold a tag into the seed key.
+    fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
+
+    def realized_adjacency(t) -> jax.Array:
+        key = jax.random.fold_in(fault_key, t)
+        return sample_surviving_adjacency(key, base_A, drop_prob)
+
+    def mix(t, x):
+        W = metropolis_hastings_weights(realized_adjacency(t))
+        return jnp.tensordot(W, x, axes=1).astype(x.dtype)
+
+    def neighbor_sum(t, x):
+        return jnp.tensordot(realized_adjacency(t), x, axes=1).astype(x.dtype)
+
+    def realized_degree_sum(t):
+        return jnp.sum(realized_adjacency(t))
+
+    return FaultyMixing(
+        mix=mix,
+        neighbor_sum=neighbor_sum,
+        realized_degree_sum=realized_degree_sum,
+        drop_prob=drop_prob,
+    )

@@ -87,9 +87,17 @@ class Simulator:
 
     # ------------------------------------------------------------------ runs
     def run_one(
-        self, label: Optional[str] = None, *, verbose: bool = True, **overrides
+        self,
+        label: Optional[str] = None,
+        *,
+        verbose: bool = True,
+        run_kwargs: Optional[dict] = None,
+        **overrides,
     ) -> ExperimentRecord:
-        """Run one experiment; ``overrides`` replace base-config fields."""
+        """Run one experiment; ``overrides`` replace base-config fields.
+
+        ``run_kwargs`` pass through to the backend (mesh=..., checkpoint=...).
+        """
         cfg = self.config.replace(**overrides) if overrides else self.config
         if label is None:
             label = (
@@ -101,7 +109,7 @@ class Simulator:
             print(f"[simulator] running {label!r} "
                   f"(algorithm={cfg.algorithm}, topology={cfg.topology}, "
                   f"backend={cfg.backend}, T={cfg.n_iterations})", file=sys.stderr)
-        result = run_algorithm(cfg, self.dataset, self.f_opt)
+        result = run_algorithm(cfg, self.dataset, self.f_opt, **(run_kwargs or {}))
         summary = summarize_run(
             label,
             result.history,

@@ -1,0 +1,150 @@
+"""Checkpoint / resume (orbax-backed).
+
+The reference has no persistence at all — state lives in memory and results
+go to stdout / an interactive plot (SURVEY.md §5.4). This subsystem saves the
+full restartable run state — the algorithm state pytree (every leaf is an
+``[N, d]``-stacked array), the metric histories accumulated so far, and the
+chunk cursor — via ``orbax.checkpoint``, so long runs survive preemption
+(standard TPU-pod operating reality) and the 256-worker stretch config can
+run in installments.
+
+RNG needs no saved state by construction: batch sampling derives keys purely
+from (config.seed, iteration, slot) via ``jax.random.fold_in``, so a resumed
+run draws exactly the batches the uninterrupted run would have (a
+deliberate improvement over the reference's single mutable global numpy
+stream, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointOptions:
+    """Where and how often to checkpoint a run.
+
+    ``every_evals``: save cadence in eval-chunks (one chunk = ``eval_every``
+    iterations). ``resume``: restore the latest checkpoint under ``directory``
+    and continue from its cursor. ``max_to_keep``: retention.
+    """
+
+    directory: str
+    every_evals: int = 10
+    resume: bool = True
+    max_to_keep: int = 3
+
+    def __post_init__(self) -> None:
+        if self.every_evals <= 0:
+            raise ValueError("every_evals must be positive")
+
+
+class RunCheckpointer:
+    """Thin orbax wrapper for one run directory.
+
+    Layout: ``<directory>/<chunk>/`` orbax PyTree checkpoints of
+    ``{"state": pytree, "gap_hist": [k], "cons_hist": [k], "chunk": k}``.
+    """
+
+    def __init__(self, options: CheckpointOptions):
+        import orbax.checkpoint as ocp
+
+        self.options = options
+        self.directory = os.path.abspath(options.directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def _step_dir(self, chunk: int) -> str:
+        return os.path.join(self.directory, f"{chunk:08d}")
+
+    # A config sidecar guards against resuming state produced by a different
+    # experiment (the horizon n_iterations is the one legitimately resumable
+    # difference — extending a run).
+    _CONFIG_SIDECAR = "run_config.json"
+    _RESUMABLE_KEYS = frozenset({"n_iterations"})
+
+    def validate_or_record_config(self, config) -> None:
+        """First save records the config; later runs must match it.
+
+        Raises ValueError naming the mismatched fields when the directory was
+        written by a different experiment.
+        """
+        import json
+
+        path = os.path.join(self.directory, self._CONFIG_SIDECAR)
+        current = {
+            k: v for k, v in config.to_dict().items()
+            if k not in self._RESUMABLE_KEYS
+        }
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                json.dump(current, f, indent=1)
+            return
+        with open(path) as f:
+            recorded = json.load(f)
+        diffs = sorted(
+            k for k in set(recorded) | set(current)
+            if recorded.get(k) != current.get(k)
+        )
+        if diffs:
+            raise ValueError(
+                f"checkpoint directory {self.directory} was written by a "
+                f"different experiment (mismatched config fields: {diffs}); "
+                "point --checkpoint-dir elsewhere or pass resume=False "
+                "after clearing it"
+            )
+
+    def completed_chunks(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            path = os.path.join(self.directory, name)
+            if name.isdigit() and os.path.isdir(path) and not name.endswith(".tmp"):
+                out.append(int(name))
+        return sorted(out)
+
+    def latest_chunk(self) -> Optional[int]:
+        chunks = self.completed_chunks()
+        return chunks[-1] if chunks else None
+
+    def save(self, chunk: int, state: Any, gap_hist, cons_hist, floats_hist=()):
+        payload = {"state": state, "chunk": np.int64(chunk)}
+        # Orbax rejects zero-size arrays; empty histories are simply omitted
+        # and default to empty on restore.
+        for name, hist in (
+            ("gap_hist", gap_hist),
+            ("cons_hist", cons_hist),
+            ("floats_hist", floats_hist),
+        ):
+            arr = np.asarray(hist, dtype=np.float64)
+            if arr.size:
+                payload[name] = arr
+        path = self._step_dir(chunk)
+        self._ckptr.save(path, payload, force=True)
+        self._gc()
+
+    def restore(self, chunk: Optional[int] = None):
+        """Return (state, gap_hist, cons_hist, floats_hist, chunk), or None."""
+        if chunk is None:
+            chunk = self.latest_chunk()
+        if chunk is None:
+            return None
+        payload = self._ckptr.restore(self._step_dir(chunk))
+        empty = np.empty(0, dtype=np.float64)
+        return (
+            payload["state"],
+            np.asarray(payload.get("gap_hist", empty)),
+            np.asarray(payload.get("cons_hist", empty)),
+            np.asarray(payload.get("floats_hist", empty)),
+            int(payload["chunk"]),
+        )
+
+    def _gc(self) -> None:
+        import shutil
+
+        chunks = self.completed_chunks()
+        for old in chunks[: -self.options.max_to_keep]:
+            shutil.rmtree(self._step_dir(old), ignore_errors=True)

@@ -1,0 +1,113 @@
+"""Runtime correctness diagnostics (SURVEY.md §5.2 build target).
+
+The reference's only invariant checking is two asserts on the mixing matrix
+(reference ``trainer.py:130-131``). The single-threaded simulator has nothing
+to race; on a real collective backend the equivalent hazards are non-finite
+propagation, nondeterministic compilation, and mis-wired collectives. Three
+checks, all usable as preflight guards or in tests:
+
+- ``nan_debugging`` — scoped ``jax_debug_nans`` so the first NaN-producing
+  primitive raises with a traceback instead of silently poisoning a 10k-step
+  scan;
+- ``check_determinism`` — run a function twice and require bitwise-identical
+  outputs (XLA compilations are deterministic given fixed inputs; divergence
+  means stray host RNG or nondeterministic collective ordering);
+- ``check_collectives`` — ppermute round-trip and psum identities on an
+  actual mesh: shifting +1 then −1 along the worker axis must reproduce the
+  input exactly, and psum of a one-hot must equal the all-ones vector.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from functools import partial
+from typing import Iterator
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def nan_debugging(enable: bool = True) -> Iterator[None]:
+    """Scoped jax_debug_nans: raise at the first NaN-producing op."""
+    import jax
+
+    if not enable:
+        yield
+        return
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def check_determinism(fn, *args, repeats: int = 2) -> None:
+    """Require ``fn(*args)`` to be bitwise reproducible across calls.
+
+    Raises AssertionError naming the first differing output leaf.
+    """
+    import jax
+
+    baseline = jax.tree.map(np.asarray, fn(*args))
+    base_leaves, treedef = jax.tree.flatten(baseline)
+    for r in range(1, repeats):
+        again = jax.tree.map(np.asarray, fn(*args))
+        again_leaves, treedef2 = jax.tree.flatten(again)
+        if treedef2 != treedef:
+            raise AssertionError(
+                f"run {r}: output structure changed: {treedef} vs {treedef2}"
+            )
+        for i, (a, b) in enumerate(zip(base_leaves, again_leaves)):
+            if not np.array_equal(a, b, equal_nan=True):
+                raise AssertionError(
+                    f"run {r}: output leaf {i} is not bitwise reproducible "
+                    f"(max abs diff {np.max(np.abs(a - b))})"
+                )
+
+
+def check_collectives(mesh=None) -> None:
+    """Verify ppermute round-trip and psum identities on a device mesh.
+
+    Raises AssertionError on any mismatch. Builds an all-device 1-D mesh when
+    none is given; a 1-device mesh degenerates gracefully (self-permutes).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_optimization_tpu.parallel.mesh import WORKER_AXIS, make_worker_mesh
+
+    if mesh is None:
+        mesh = make_worker_mesh(len(jax.devices()))
+    k = mesh.devices.size
+    axis = mesh.axis_names[0] if mesh.axis_names else WORKER_AXIS
+
+    x = np.arange(k * 3, dtype=np.float32).reshape(k, 3)
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None)
+    )
+    def roundtrip(block):
+        fwd = [(i, (i + 1) % k) for i in range(k)]
+        back = [(i, (i - 1) % k) for i in range(k)]
+        out = jax.lax.ppermute(block, axis, fwd)
+        return jax.lax.ppermute(out, axis, back)
+
+    got = np.asarray(jax.jit(roundtrip)(x))
+    if not np.array_equal(got, x):
+        raise AssertionError("ppermute +1/-1 round-trip is not the identity")
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None)
+    )
+    def total(block):
+        return jnp.broadcast_to(
+            jax.lax.psum(jnp.sum(block, axis=0, keepdims=True), axis), block.shape
+        )
+
+    got = np.asarray(jax.jit(total)(x))
+    expect = np.broadcast_to(x.sum(axis=0, keepdims=True), x.shape)
+    if not np.allclose(got, expect, rtol=1e-6):
+        raise AssertionError("psum over the worker axis disagrees with host sum")

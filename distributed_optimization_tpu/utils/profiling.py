@@ -1,0 +1,70 @@
+"""Profiling and phase timing (SURVEY.md §5.1 build target).
+
+The reference's only observability is coarse per-iteration wall-clock deltas
+(reference ``trainer.py:35,63,71``). Here:
+
+- ``PhaseTimer`` — named phase accounting (data gen, oracle, compile,
+  steady-state run), so compile time never pollutes the iters/sec headline
+  (the jax backend already separates AOT compile from execution; this makes
+  the same split available to scripts and the CLI);
+- ``trace`` — context manager around ``jax.profiler`` trace collection for
+  TensorBoard/XProf on real TPU runs, a no-op when profiling is unavailable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Iterator, Optional
+
+
+@dataclasses.dataclass
+class PhaseTimer:
+    """Accumulates wall-clock seconds per named phase."""
+
+    phases: dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = (
+                self.phases.get(name, 0.0) + time.perf_counter() - start
+            )
+
+    def report(self) -> str:
+        total = sum(self.phases.values())
+        lines = [f"{'phase':<24}{'seconds':>10}{'share':>8}"]
+        for name, secs in sorted(self.phases.items(), key=lambda kv: -kv[1]):
+            share = secs / total if total > 0 else 0.0
+            lines.append(f"{name:<24}{secs:>10.3f}{share:>7.1%}")
+        lines.append(f"{'total':<24}{total:>10.3f}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Collect a jax.profiler trace into ``log_dir`` (no-op if None/fails).
+
+    View with TensorBoard's profile plugin / XProf. Failure to start the
+    profiler (e.g. unsupported platform) degrades to a no-op rather than
+    killing the run.
+    """
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # pragma: no cover - platform dependent
+        print(f"[profiling] trace unavailable: {e}")
+        yield
+        return
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

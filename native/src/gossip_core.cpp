@@ -1,0 +1,267 @@
+// Native host-simulator core: the reference-semantics training loops in C++.
+//
+// The reference's hot path is T x N Python-level worker iterations with a
+// full-dataset objective evaluation every iteration (reference
+// trainer.py:41-71 centralized, trainer.py:161-193 decentralized). The numpy
+// oracle backend reproduces those semantics faithfully but pays the Python
+// interpreter per iteration; this core implements the same two algorithms
+// (centralized SGD, D-SGD with an arbitrary dense mixing matrix) as a tight
+// C++ loop behind a plain C ABI, loaded via ctypes — the framework's native
+// runtime tier for hosts (the TPU tier is XLA; see backends/cpp_backend.py).
+//
+// Semantics notes:
+// - Batch sampling is without replacement via partial Fisher-Yates on a
+//   SplitMix64/xoshiro256** stream seeded from (seed, t, worker): the numpy
+//   oracle's exact batch sequence is not reproducible (different RNG), which
+//   matches the framework-wide stance that cross-backend parity is
+//   statistical unless batches are injected (SURVEY.md §7 hard part a).
+// - Objectives/gradients use the same closed forms and stability guards as
+//   ops/losses_np.py (stable softplus for logistic).
+// - float64 throughout, like the numpy oracle.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// ----------------------------------------------------------------- RNG
+struct SplitMix64 {
+  uint64_t s;
+  explicit SplitMix64(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+};
+
+struct Xoshiro256ss {
+  uint64_t s[4];
+  explicit Xoshiro256ss(uint64_t seed) {
+    SplitMix64 sm(seed);
+    for (auto &x : s) x = sm.next();
+  }
+  static uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+  uint64_t next() {
+    uint64_t result = rotl(s[1] * 5, 7) * 9;
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+  // Unbiased bounded draw (Lemire-style rejection).
+  uint64_t bounded(uint64_t n) {
+    uint64_t x, r;
+    do {
+      x = next();
+      r = x % n;
+    } while (x - r > UINT64_MAX - n + 1);
+    return r;
+  }
+};
+
+// Partial Fisher-Yates: first b entries of a shuffled [0, n) index range.
+void sample_without_replacement(Xoshiro256ss &rng, int64_t n, int64_t b,
+                                std::vector<int64_t> &scratch,
+                                std::vector<int64_t> &out) {
+  scratch.resize(n);
+  for (int64_t i = 0; i < n; ++i) scratch[i] = i;
+  out.resize(b);
+  for (int64_t i = 0; i < b; ++i) {
+    int64_t j = i + static_cast<int64_t>(rng.bounded(n - i));
+    std::swap(scratch[i], scratch[j]);
+    out[i] = scratch[i];
+  }
+}
+
+// ------------------------------------------------------------- objectives
+constexpr int kLogistic = 0;
+constexpr int kQuadratic = 1;
+
+inline double dot(const double *a, const double *b, int64_t d) {
+  double acc = 0.0;
+  for (int64_t k = 0; k < d; ++k) acc += a[k] * b[k];
+  return acc;
+}
+
+// Full-dataset objective: mean loss + (reg/2)||w||^2 (losses_np parity).
+double full_objective(int problem, const double *X, const double *y,
+                      int64_t n, int64_t d, const double *w, double reg) {
+  double acc = 0.0;
+#pragma omp parallel for reduction(+ : acc) schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    double z = dot(X + i * d, w, d);
+    if (problem == kLogistic) {
+      double yz = y[i] * z;
+      // stable log(1 + exp(-yz)) = max(0, -yz) + log1p(exp(-|yz|))
+      double m = yz < 0.0 ? -yz : 0.0;
+      acc += m + std::log1p(std::exp(-std::fabs(yz)));
+    } else {
+      double r = z - y[i];
+      acc += 0.5 * r * r;
+    }
+  }
+  double obj = acc / static_cast<double>(n);
+  obj += 0.5 * reg * dot(w, w, d);
+  return obj;
+}
+
+// Stochastic gradient over batch rows `idx` of one worker's shard.
+void stochastic_gradient(int problem, const double *Xs, const double *ys,
+                         int64_t d, const std::vector<int64_t> &idx,
+                         const double *w, double reg, double *g_out) {
+  std::memset(g_out, 0, sizeof(double) * d);
+  const auto b = static_cast<int64_t>(idx.size());
+  if (b == 0) {
+    for (int64_t k = 0; k < d; ++k) g_out[k] = reg * w[k];
+    return;
+  }
+  for (int64_t t = 0; t < b; ++t) {
+    const double *xi = Xs + idx[t] * d;
+    double z = dot(xi, w, d);
+    double coef;
+    if (problem == kLogistic) {
+      double yz = ys[idx[t]] * z;
+      // -y * sigmoid(-yz)
+      double s = 1.0 / (1.0 + std::exp(yz));
+      coef = -ys[idx[t]] * s;
+    } else {
+      coef = z - ys[idx[t]];
+    }
+    for (int64_t k = 0; k < d; ++k) g_out[k] += coef * xi[k];
+  }
+  double inv_b = 1.0 / static_cast<double>(b);
+  for (int64_t k = 0; k < d; ++k) g_out[k] = g_out[k] * inv_b + reg * w[k];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Shared driver for both algorithms.
+//
+// X, y: concatenated per-worker shards, [n_total, d] row-major / [n_total];
+// offsets: [n_workers + 1] shard boundaries into X/y rows;
+// W: [n_workers, n_workers] dense mixing matrix (ignored when centralized);
+// centralized: 1 = parameter-server SGD, 0 = D-SGD;
+// sqrt_decay: 1 = eta0/sqrt(t+1), 0 = constant eta0;
+// out_models: [n_workers, d] final per-worker models (centralized: rows equal);
+// collect_metrics: 0 skips all objective/consensus evaluation (pure
+//            iteration throughput; out_gap/out_cons left untouched);
+// out_gap:   [T / eval_every] full-data objective values (NOT gap; caller
+//            subtracts f_opt host-side);
+// out_cons:  [T / eval_every] consensus error, untouched when centralized.
+// Returns 0 on success, nonzero on invalid arguments.
+int run_simulation(const double *X, const double *y, const int64_t *offsets,
+                   int64_t n_workers, int64_t d, const double *W,
+                   int centralized, int problem, int64_t T,
+                   int64_t batch_size, double eta0, int sqrt_decay,
+                   double reg, uint64_t seed, int64_t eval_every,
+                   int collect_metrics,
+                   double *out_models, double *out_gap, double *out_cons) {
+  if (n_workers <= 0 || d <= 0 || T < 0 || eval_every <= 0 ||
+      T % eval_every != 0 || batch_size < 0) {
+    return 1;
+  }
+  if (problem != kLogistic && problem != kQuadratic) return 2;
+  const int64_t n_total = offsets[n_workers];
+
+  std::vector<double> models(n_workers * d, 0.0);
+  std::vector<double> grads(n_workers * d, 0.0);
+  std::vector<double> mixed(n_workers * d, 0.0);
+  std::vector<double> avg(d, 0.0);
+
+  for (int64_t t = 0; t < T; ++t) {
+    const double eta =
+        sqrt_decay ? eta0 / std::sqrt(static_cast<double>(t) + 1.0) : eta0;
+
+    // Local (or global) stochastic gradients.
+#pragma omp parallel
+    {
+      std::vector<int64_t> scratch, idx;
+#pragma omp for schedule(static)
+      for (int64_t i = 0; i < n_workers; ++i) {
+        const int64_t lo = offsets[i], hi = offsets[i + 1];
+        const int64_t ni = hi - lo;
+        const int64_t b = batch_size < ni ? batch_size : ni;
+        // Stream keyed by (seed, t, worker): reproducible, order-free —
+        // the counter-based-key design of ops/sampling.py, host-side.
+        Xoshiro256ss rng(seed ^ (0x9e3779b97f4a7c15ULL * (uint64_t)(t + 1)) ^
+                         (0xbf58476d1ce4e5b9ULL * (uint64_t)(i + 1)));
+        if (ni > 0 && b > 0) {
+          sample_without_replacement(rng, ni, b, scratch, idx);
+        } else {
+          idx.clear();
+        }
+        const double *params = centralized ? models.data() : models.data() + i * d;
+        stochastic_gradient(problem, X + lo * d, y + lo, d, idx, params, reg,
+                            grads.data() + i * d);
+      }
+    }
+
+    if (centralized) {
+      // psum-mean of worker gradients, step the (shared) row-0 model.
+      for (int64_t i = 1; i < n_workers; ++i)
+        for (int64_t k = 0; k < d; ++k) grads[k] += grads[i * d + k];
+      const double inv_n = 1.0 / static_cast<double>(n_workers);
+      for (int64_t k = 0; k < d; ++k)
+        models[k] -= eta * grads[k] * inv_n;
+    } else {
+      // Gossip: mixed = W @ models, then the local SGD step.
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < n_workers; ++i) {
+        double *mi = mixed.data() + i * d;
+        std::memset(mi, 0, sizeof(double) * d);
+        for (int64_t j = 0; j < n_workers; ++j) {
+          const double w_ij = W[i * n_workers + j];
+          if (w_ij == 0.0) continue;
+          const double *xj = models.data() + j * d;
+          for (int64_t k = 0; k < d; ++k) mi[k] += w_ij * xj[k];
+        }
+        const double *gi = grads.data() + i * d;
+        for (int64_t k = 0; k < d; ++k) mi[k] -= eta * gi[k];
+      }
+      models.swap(mixed);
+    }
+
+    if (collect_metrics && (t + 1) % eval_every == 0) {
+      const int64_t row = (t + 1) / eval_every - 1;
+      if (centralized) {
+        out_gap[row] = full_objective(problem, X, y, n_total, d, models.data(), reg);
+      } else {
+        std::memset(avg.data(), 0, sizeof(double) * d);
+        for (int64_t i = 0; i < n_workers; ++i)
+          for (int64_t k = 0; k < d; ++k) avg[k] += models[i * d + k];
+        const double inv_n = 1.0 / static_cast<double>(n_workers);
+        for (int64_t k = 0; k < d; ++k) avg[k] *= inv_n;
+        out_gap[row] = full_objective(problem, X, y, n_total, d, avg.data(), reg);
+        double ce = 0.0;
+        for (int64_t i = 0; i < n_workers; ++i) {
+          const double *xi = models.data() + i * d;
+          for (int64_t k = 0; k < d; ++k) {
+            const double diff = xi[k] - avg[k];
+            ce += diff * diff;
+          }
+        }
+        out_cons[row] = ce * inv_n;
+      }
+    }
+  }
+
+  if (centralized) {
+    for (int64_t i = 0; i < n_workers; ++i)
+      std::memcpy(out_models + i * d, models.data(), sizeof(double) * d);
+  } else {
+    std::memcpy(out_models, models.data(), sizeof(double) * n_workers * d);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,139 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4 build target).
+
+The load-bearing property: a run that is killed mid-way and resumed from its
+latest orbax checkpoint produces EXACTLY the trajectory (models + metric
+histories) of an uninterrupted run — possible because batch sampling derives
+keys purely from (seed, iteration), never from carried RNG state.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.utils.checkpoint import (
+    CheckpointOptions,
+    RunCheckpointer,
+)
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+CFG = ExperimentConfig(
+    n_workers=8,
+    n_samples=320,
+    n_features=10,
+    n_informative_features=6,
+    n_iterations=40,
+    local_batch_size=8,
+    problem_type="quadratic",
+    algorithm="dsgd",
+    topology="ring",
+    eval_every=4,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    return ds, f_opt
+
+
+def test_checkpointed_run_matches_fused_run(data, tmp_path):
+    ds, f_opt = data
+    fused = jax_backend.run(CFG, ds, f_opt)
+    ckpt = jax_backend.run(
+        CFG, ds, f_opt,
+        checkpoint=CheckpointOptions(str(tmp_path / "ck"), every_evals=3),
+    )
+    np.testing.assert_allclose(
+        ckpt.final_models, fused.final_models, rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        ckpt.history.objective, fused.history.objective, rtol=1e-5, atol=1e-7
+    )
+
+
+def test_resume_continues_exactly(data, tmp_path):
+    ds, f_opt = data
+    ckdir = str(tmp_path / "ck")
+    full = jax_backend.run(
+        CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir + "_full")
+    )
+
+    # "Interrupted" run: only the first 5 of 10 chunks, saved every 5.
+    half_cfg = CFG.replace(n_iterations=20)
+    jax_backend.run(
+        half_cfg, ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir, every_evals=5, resume=False),
+    )
+    ck = RunCheckpointer(CheckpointOptions(ckdir))
+    assert ck.latest_chunk() == 5
+
+    # Resume with the full horizon: picks up at chunk 5, finishes 6..10.
+    resumed = jax_backend.run(
+        CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir, every_evals=5)
+    )
+    np.testing.assert_allclose(
+        resumed.final_models, full.final_models, rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        resumed.history.objective, full.history.objective, rtol=1e-5, atol=1e-7
+    )
+    assert len(resumed.history.objective) == CFG.n_iterations // CFG.eval_every
+
+
+def test_retention_gc(data, tmp_path):
+    ds, f_opt = data
+    opts = CheckpointOptions(str(tmp_path / "ck"), every_evals=2, max_to_keep=2)
+    jax_backend.run(CFG, ds, f_opt, checkpoint=opts)
+    ck = RunCheckpointer(opts)
+    assert len(ck.completed_chunks()) <= 2
+    assert ck.latest_chunk() == 10
+
+
+def test_resume_rejects_mismatched_config(data, tmp_path):
+    ds, f_opt = data
+    ckdir = str(tmp_path / "ck")
+    jax_backend.run(CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir))
+    with pytest.raises(ValueError, match="different experiment"):
+        jax_backend.run(
+            CFG.replace(learning_rate_eta0=0.01), ds, f_opt,
+            checkpoint=CheckpointOptions(ckdir),
+        )
+    # A longer horizon with identical hyperparameters IS a valid resume.
+    jax_backend.run(
+        CFG.replace(n_iterations=80), ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir),
+    )
+
+
+def test_resume_rejects_shrunken_horizon(data, tmp_path):
+    ds, f_opt = data
+    ckdir = str(tmp_path / "ck")
+    jax_backend.run(CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir))
+    with pytest.raises(ValueError, match="horizon"):
+        jax_backend.run(
+            CFG.replace(n_iterations=20), ds, f_opt,
+            checkpoint=CheckpointOptions(ckdir),
+        )
+
+
+def test_fully_restored_run_reports_no_throughput(data, tmp_path):
+    ds, f_opt = data
+    ckdir = str(tmp_path / "ck")
+    jax_backend.run(CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir))
+    again = jax_backend.run(CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir))
+    # Zero iterations executed this process -> no throughput claim.
+    assert np.isnan(again.history.iters_per_second)
+
+
+def test_restore_empty_returns_none(tmp_path):
+    ck = RunCheckpointer(CheckpointOptions(str(tmp_path / "empty")))
+    assert ck.restore() is None
+    assert ck.latest_chunk() is None
+
+
+def test_invalid_options():
+    with pytest.raises(ValueError):
+        CheckpointOptions("/tmp/x", every_evals=0)

@@ -1,0 +1,121 @@
+"""Native (C++) backend tests: build, correctness vs oracle, guards.
+
+Cross-backend parity is statistical (different RNG streams draw different
+batches — same stance as jax-vs-numpy, SURVEY.md §7 hard part a): curves must
+track the numpy oracle closely, not bitwise.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+cpp_backend = pytest.importorskip(
+    "distributed_optimization_tpu.backends.cpp_backend"
+)
+
+try:
+    cpp_backend.load_library()
+    _HAVE_NATIVE = True
+except cpp_backend.NativeBuildError:  # pragma: no cover - missing toolchain
+    _HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(
+    not _HAVE_NATIVE, reason="native toolchain unavailable"
+)
+
+CFG = ExperimentConfig(
+    n_workers=9, n_samples=450, n_features=10, n_informative_features=6,
+    n_iterations=800, local_batch_size=8, problem_type="quadratic",
+    algorithm="dsgd", topology="ring", eval_every=1,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    return ds, f_opt
+
+
+@pytest.mark.parametrize("problem", ["quadratic", "logistic"])
+@pytest.mark.parametrize("algorithm,topology", [
+    ("dsgd", "ring"), ("dsgd", "grid"), ("dsgd", "fully_connected"),
+    ("centralized", "ring"),
+])
+def test_tracks_numpy_oracle(problem, algorithm, topology, data):
+    from distributed_optimization_tpu.backends import numpy_backend
+
+    ds, f_opt = data
+    cfg = CFG.replace(problem_type="quadratic", algorithm=algorithm,
+                      topology=topology)
+    if problem == "logistic":
+        cfg = cfg.replace(problem_type="logistic")
+        ds = generate_synthetic_dataset(cfg)
+        _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    r_cpp = cpp_backend.run(cfg, ds, f_opt)
+    r_np = numpy_backend.run(cfg, ds, f_opt)
+    # Same start (deterministic given x0 = 0 up to batch draw), same
+    # asymptote: compare the last-quarter mean of the convergence curves.
+    tail = slice(-len(r_cpp.history.objective) // 4, None)
+    a = r_cpp.history.objective[tail].mean()
+    b = r_np.history.objective[tail].mean()
+    assert np.isfinite(a) and np.isfinite(b)
+    assert abs(a - b) <= 0.12 * max(abs(a), abs(b), 1e-3)
+    # Identical analytic comms accounting.
+    assert (
+        r_cpp.history.total_floats_transmitted
+        == r_np.history.total_floats_transmitted
+    )
+
+
+def test_centralized_rows_identical(data):
+    ds, f_opt = data
+    r = cpp_backend.run(CFG.replace(algorithm="centralized"), ds, f_opt)
+    assert np.allclose(r.final_models, r.final_models[0])
+    assert r.history.consensus_error is None
+
+
+def test_consensus_shrinks(data):
+    ds, f_opt = data
+    r = cpp_backend.run(CFG, ds, f_opt)
+    ce = r.history.consensus_error
+    assert ce[-1] < ce[5]
+
+
+def test_deterministic_given_seed(data):
+    ds, f_opt = data
+    a = cpp_backend.run(CFG, ds, f_opt)
+    b = cpp_backend.run(CFG, ds, f_opt)
+    np.testing.assert_array_equal(a.final_models, b.final_models)
+    c = cpp_backend.run(CFG.replace(seed=7), ds, f_opt)
+    assert not np.array_equal(a.final_models, c.final_models)
+
+
+def test_rejects_unsupported(data):
+    ds, f_opt = data
+    with pytest.raises(ValueError, match="jax-backend capability"):
+        cpp_backend.run(CFG.replace(algorithm="extra"), ds, f_opt)
+    with pytest.raises(ValueError, match="jax-only"):
+        cpp_backend.run(CFG.replace(edge_drop_prob=0.2), ds, f_opt)
+
+
+def test_empty_shards_stay_finite():
+    cfg = CFG.replace(n_workers=9, n_samples=6, n_iterations=20,
+                      suboptimality_threshold=1e12)
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    r = cpp_backend.run(cfg, ds, f_opt)
+    assert np.all(np.isfinite(r.final_models))
+
+
+def test_backend_dispatch():
+    from distributed_optimization_tpu.backends.base import run_algorithm
+
+    cfg = CFG.replace(backend="cpp", n_iterations=50)
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    r = run_algorithm(cfg, ds, f_opt)
+    assert len(r.history.objective) == 50

@@ -1,0 +1,68 @@
+"""Tests for the profiling/diagnostics subsystems (SURVEY.md §5.1-5.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.utils.diagnostics import (
+    check_collectives,
+    check_determinism,
+    nan_debugging,
+)
+from distributed_optimization_tpu.utils.profiling import PhaseTimer
+
+
+def test_phase_timer_accumulates_and_reports():
+    timer = PhaseTimer()
+    with timer.phase("a"):
+        pass
+    with timer.phase("a"):
+        pass
+    with timer.phase("b"):
+        pass
+    assert set(timer.phases) == {"a", "b"}
+    assert timer.phases["a"] >= 0.0
+    report = timer.report()
+    assert "a" in report and "total" in report
+
+
+def test_nan_debugging_raises_on_nan():
+    with nan_debugging(True):
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()
+    # Config restored: same op silently yields NaN outside the scope.
+    out = jax.jit(lambda x: jnp.log(x + 0.0))(jnp.asarray(-1.0))
+    assert np.isnan(out)
+
+
+def test_nan_debugging_disabled_is_noop():
+    with nan_debugging(False):
+        out = jnp.log(jnp.asarray(-1.0))
+    assert np.isnan(out)
+
+
+def test_check_determinism_passes_for_pure_fn():
+    fn = jax.jit(lambda x: {"y": x * 2, "z": jnp.cumsum(x)})
+    check_determinism(fn, jnp.arange(8.0))
+
+
+def test_check_determinism_catches_impure_fn():
+    rng = np.random.default_rng(0)
+
+    def impure(x):
+        return x + rng.standard_normal(x.shape)
+
+    with pytest.raises(AssertionError, match="not bitwise reproducible"):
+        check_determinism(impure, np.zeros(4))
+
+
+def test_check_collectives_all_devices():
+    check_collectives()  # 8 virtual CPU devices via conftest
+
+
+def test_check_collectives_subset_mesh():
+    from distributed_optimization_tpu.parallel.mesh import make_worker_mesh
+
+    check_collectives(make_worker_mesh(4, devices=jax.devices()[:4]))
+    check_collectives(make_worker_mesh(1, devices=jax.devices()[:1]))

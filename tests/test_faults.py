@@ -1,0 +1,136 @@
+"""Failure-injection tests (SURVEY.md §5.3 build target).
+
+Properties: every realized W_t stays symmetric + doubly stochastic (average
+preservation under faults); drop_prob=0 reduces exactly to the static MH
+matrix; realizations are reproducible from (seed, t); D-SGD still converges
+under moderate edge loss; the realized comms accounting is < the fault-free
+closed form.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend, numpy_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.parallel.faults import (
+    make_faulty_mixing,
+    metropolis_hastings_weights,
+    sample_surviving_adjacency,
+)
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+
+@pytest.mark.parametrize("topology", ["ring", "grid", "fully_connected",
+                                      "erdos_renyi"])
+def test_realized_W_is_symmetric_doubly_stochastic(topology):
+    topo = build_topology(topology, 9, erdos_renyi_p=0.5, seed=1)
+    A = jnp.asarray(topo.adjacency, dtype=jnp.float32)
+    for t in range(5):
+        key = jax.random.fold_in(jax.random.key(7), t)
+        At = sample_surviving_adjacency(key, A, 0.4)
+        W = np.asarray(metropolis_hastings_weights(At))
+        np.testing.assert_allclose(W, W.T, atol=1e-6)
+        np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-5)
+        assert np.all(W >= -1e-6)
+        # Surviving edges are a subset of the base adjacency.
+        assert np.all(np.asarray(At) <= np.asarray(A))
+
+
+def test_zero_drop_prob_matches_static_matrix():
+    topo = build_topology("ring", 8)
+    fm = make_faulty_mixing(topo, 0.0, seed=3)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((8, 4)),
+                    dtype=jnp.float32)
+    got = np.asarray(fm.mix(jnp.asarray(0), x))
+    want = topo.mixing_matrix @ np.asarray(x, dtype=np.float64)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert float(fm.realized_degree_sum(jnp.asarray(0))) == topo.degrees.sum()
+
+
+def test_fault_realizations_reproducible_and_time_varying():
+    topo = build_topology("fully_connected", 10)
+    fm = make_faulty_mixing(topo, 0.5, seed=11)
+    x = jnp.ones((10, 3), dtype=jnp.float32)
+    a = np.asarray(fm.mix(jnp.asarray(4), x))
+    b = np.asarray(fm.mix(jnp.asarray(4), x))
+    np.testing.assert_array_equal(a, b)  # same t -> same realization
+    sums = {float(fm.realized_degree_sum(jnp.asarray(t))) for t in range(8)}
+    assert len(sums) > 1  # realizations vary over time
+
+
+def test_mean_preserved_under_faults():
+    # W_t doubly stochastic => the network average is invariant through mixing.
+    topo = build_topology("grid", 9)
+    fm = make_faulty_mixing(topo, 0.3, seed=5)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((9, 6)),
+                    dtype=jnp.float32)
+    for t in range(4):
+        mixed = fm.mix(jnp.asarray(t), x)
+        np.testing.assert_allclose(
+            np.asarray(jnp.mean(mixed, axis=0)),
+            np.asarray(jnp.mean(x, axis=0)),
+            atol=1e-5,
+        )
+
+
+CFG = ExperimentConfig(
+    n_workers=9, n_samples=360, n_features=10, n_informative_features=6,
+    n_iterations=600, local_batch_size=8, problem_type="quadratic",
+    algorithm="dsgd", topology="ring", eval_every=50,
+)
+
+
+def test_dsgd_converges_under_faults_and_floats_accounting():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    clean = jax_backend.run(CFG, ds, f_opt)
+    faulty = jax_backend.run(CFG.replace(edge_drop_prob=0.3), ds, f_opt)
+    # Still optimizing (gap shrinks substantially from its start).
+    assert faulty.history.objective[-1] < 0.2 * faulty.history.objective[0]
+    # Realized communication < fault-free closed form, > half at p=0.3.
+    clean_floats = clean.history.total_floats_transmitted
+    assert faulty.history.total_floats_transmitted < clean_floats
+    assert faulty.history.total_floats_transmitted > 0.5 * clean_floats
+
+
+def test_numpy_backend_rejects_faults():
+    ds = generate_synthetic_dataset(CFG)
+    with pytest.raises(ValueError, match="jax-backend capability"):
+        numpy_backend.run(CFG.replace(edge_drop_prob=0.1), ds, 0.0)
+
+
+def test_shard_map_mixing_rejects_faults():
+    ds = generate_synthetic_dataset(CFG)
+    with pytest.raises(ValueError, match="dense/stencil"):
+        jax_backend.run(
+            CFG.replace(edge_drop_prob=0.1, mixing_impl="shard_map"), ds, 0.0
+        )
+
+
+def test_admm_rejects_faults():
+    ds = generate_synthetic_dataset(CFG)
+    with pytest.raises(ValueError, match="static degree"):
+        jax_backend.run(
+            CFG.replace(algorithm="admm", edge_drop_prob=0.1,
+                        lr_schedule="constant"),
+            ds, 0.0,
+        )
+
+
+def test_centralized_rejects_faults():
+    ds = generate_synthetic_dataset(CFG)
+    with pytest.raises(ValueError, match="decentralized"):
+        jax_backend.run(
+            CFG.replace(algorithm="centralized", edge_drop_prob=0.1), ds, 0.0
+        )
+
+
+def test_invalid_drop_prob():
+    with pytest.raises(ValueError):
+        ExperimentConfig(edge_drop_prob=1.0)
+    with pytest.raises(ValueError):
+        ExperimentConfig(edge_drop_prob=-0.1)
