@@ -1,13 +1,18 @@
-"""Headline benchmark: D-SGD steady-state throughput vs the CPU simulator.
+"""Headline benchmark: the BASELINE.json north-star configuration.
 
-Runs the reference study's flagship decentralized config (logistic regression,
-N=25 workers, ring topology, T=10,000 iterations, full-dataset suboptimality
-evaluated every iteration — reference ``main.py:6-21`` / PDF §III-A) on the
-JAX/XLA backend, and compares iterations/second against the numpy
-reference-semantics simulator measured on the same machine (the reference
-publishes no wall-clock numbers — BASELINE.md — so the baseline is the
-reference-equivalent simulator's measured throughput, per BASELINE.json's
-north star).
+Two measurements, one JSON line:
+
+1. **Parity check** (stderr): the reference study's flagship decentralized
+   config — logistic, N=25, ring, T=10,000, full-dataset suboptimality every
+   iteration (reference ``main.py:6-21`` / PDF §III-A) — must converge to
+   ε ≤ 0.08 in an iteration count consistent with the published Table I
+   (9,927). Guards against benchmarking a broken optimizer.
+
+2. **Headline** (stdout JSON): the north-star scale config named in
+   BASELINE.json — 256-worker decentralized logistic regression on a ring —
+   JAX/TPU backend iterations/second vs the CPU reference-semantics simulator
+   measured on this same machine (the reference publishes no wall-clock
+   numbers — BASELINE.md; the stated target is ≥50× the CPU simulator).
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": ..., "unit": "iters/sec", "vs_baseline": ...}
@@ -27,48 +32,72 @@ def main() -> None:
     from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
     from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
 
-    config = ExperimentConfig(
+    # --- 1. reference-parity convergence check (N=25, published config) ---
+    parity_cfg = ExperimentConfig(
         problem_type="logistic", algorithm="dsgd", topology="ring"
     )  # reference defaults: N=25, T=10000, b=16, eta0=0.05, lambda=1e-4
-
     t0 = time.perf_counter()
-    dataset = generate_synthetic_dataset(config)
-    _, f_opt = compute_reference_optimum(dataset, config.reg_param)
+    parity_ds = generate_synthetic_dataset(parity_cfg)
+    _, parity_f_opt = compute_reference_optimum(parity_ds, parity_cfg.reg_param)
+    parity = jax_backend.run(parity_cfg, parity_ds, parity_f_opt)
+    reached = iterations_to_threshold(
+        parity.history.objective,
+        parity_cfg.suboptimality_threshold,
+        parity.history.eval_iterations,
+    )
     print(
-        f"[bench] data+oracle ready in {time.perf_counter() - t0:.1f}s "
-        f"(f_opt={f_opt:.6f})",
+        f"[bench] parity N=25 ring logistic: {parity.history.iters_per_second:.0f} "
+        f"iters/sec, iters-to-0.08 = {reached} (reference Table I: 9927), "
+        f"final gap {parity.history.objective[-1]:.4f} "
+        f"[{time.perf_counter() - t0:.0f}s]",
+        file=sys.stderr,
+    )
+    if not (0 < reached <= parity_cfg.n_iterations):
+        raise SystemExit(
+            "parity config failed to reach the reference's suboptimality "
+            "threshold — refusing to report throughput for a broken optimizer"
+        )
+
+    # --- 2. north-star scale config: N=256 decentralized logistic ---
+    cfg = parity_cfg.replace(n_workers=256)
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+
+    base_iters = 200
+    base = numpy_backend.run(cfg.replace(n_iterations=base_iters), ds, f_opt)
+    baseline_ips = base.history.iters_per_second
+    print(
+        f"[bench] N=256 numpy reference-semantics simulator: "
+        f"{baseline_ips:.1f} iters/sec",
         file=sys.stderr,
     )
 
-    # --- baseline: numpy reference-semantics simulator, short run scaled ---
-    base_iters = 400
-    base = numpy_backend.run(
-        config.replace(n_iterations=base_iters), dataset, f_opt
-    )
-    baseline_ips = base.history.iters_per_second
-    print(f"[bench] numpy oracle: {baseline_ips:.1f} iters/sec", file=sys.stderr)
-
-    # --- JAX backend: full T=10k run, metrics on-device every iteration ---
-    result = jax_backend.run(config, dataset, f_opt)
+    result = jax_backend.run(cfg, ds, f_opt)
     hist = result.history
     jax_ips = hist.iters_per_second
-    reached = iterations_to_threshold(
-        hist.objective, config.suboptimality_threshold, hist.eval_iterations
-    )
     print(
-        f"[bench] jax backend: {jax_ips:.1f} iters/sec "
-        f"(compile {hist.compile_seconds:.1f}s, "
-        f"final gap {hist.objective[-1]:.4f}, "
-        f"iters-to-0.08 {reached}, reference table: 9927)",
+        f"[bench] N=256 jax backend: {jax_ips:.0f} iters/sec "
+        f"(compile {hist.compile_seconds:.1f}s, final gap "
+        f"{hist.objective[-1]:.4f}, consensus {hist.consensus_error[-1]:.2e})",
         file=sys.stderr,
     )
-    if not (hist.objective[-1] < 1.0):
-        raise SystemExit("benchmark run diverged — refusing to report")
+    import numpy as np
+
+    if not np.all(np.isfinite(hist.objective)):
+        raise SystemExit("north-star run produced non-finite metrics")
+    # Convergence gate on the headline run itself (N=256 consensus is slow —
+    # spectral gap ~2e-5 — so full threshold convergence is not expected in
+    # 10k iters, but the gap must be shrinking and bounded).
+    if not (hist.objective[-1] < 1.0 and hist.objective[-1] < hist.objective[0]):
+        raise SystemExit(
+            "north-star run is not optimizing — refusing to report "
+            f"throughput (gap {hist.objective[0]:.4f} -> {hist.objective[-1]:.4f})"
+        )
 
     print(
         json.dumps(
             {
-                "metric": "dsgd_ring_logistic_N25_T10k_iters_per_sec",
+                "metric": "dsgd_ring_logistic_N256_T10k_iters_per_sec",
                 "value": round(jax_ips, 2),
                 "unit": "iters/sec",
                 "vs_baseline": round(jax_ips / baseline_ips, 2),

@@ -20,7 +20,7 @@ single ``psum`` (SURVEY.md C3).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 
@@ -78,6 +78,10 @@ class Algorithm:
     # ``neighbor_sum`` with static degree constants (ADMM's dual update),
     # which a dropped edge would bias.
     supports_edge_faults: bool = True
+    # Optional override of the per-edge float payload for comms accounting:
+    # (config, d) -> floats per edge per iteration. None = d · gossip_rounds
+    # (full-vector exchange). Compressed-gossip algorithms set this.
+    comm_payload: Optional[Callable[[Any, int], float]] = None
 
 
 _REGISTRY: dict[str, Algorithm] = {}
@@ -92,6 +96,7 @@ def get_algorithm(name: str) -> Algorithm:
     from distributed_optimization_tpu.algorithms import (  # noqa: F401
         admm,
         centralized,
+        choco,
         dsgd,
         extra,
         gradient_tracking,

@@ -1,0 +1,74 @@
+"""CHOCO-SGD: decentralized SGD with compressed gossip.
+
+Not in the reference (full d-vectors on every edge, reference
+``trainer.py:169-173``); this is the compressed-communication capability from
+Koloskova, Stich & Jaggi '19 ("Decentralized Stochastic Optimization and
+Gossip Algorithms with Compressed Communication" — the report's ref [13]
+authors), which trades gossip bandwidth for a consensus step size:
+
+    x_i^{t+1/2} = x_i^t − η_t g_i(x_i^t)
+    q_i^t       = Q(x_i^{t+1/2} − x̂_i^t)          ← the ONLY bits transmitted
+    x̂_i^{t+1}   = x̂_i^t + q_i^t                    (neighbors update copies)
+    x_i^{t+1}   = x_i^{t+1/2} + γ Σ_j W_ij (x̂_j^{t+1} − x̂_i^{t+1}·δ_ij…)
+                = x_i^{t+1/2} + γ [(W − I) X̂^{t+1}]_i
+
+With identity compression and γ = 1 this is exactly D-SGD in its
+"adapt-then-combine" form, x^{t+1} = W (x^t − η g) (the property the tests
+pin down). The stacked form keeps X and X̂ as two [N, d] leaves; the estimate
+update is local, and (W − I) X̂ reuses the standard ``mix`` collective, so
+compression composes with every mixing implementation and with edge-failure
+injection (any doubly stochastic W_t preserves the analysis).
+
+Comms accounting: each edge carries the compressor's payload instead of d
+floats per iteration (``comm_payload``, consumed by the backends' float
+accounting) — top-k/random-k count k values + k indices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_optimization_tpu.algorithms.base import (
+    Algorithm,
+    State,
+    StepContext,
+    register_algorithm,
+)
+from distributed_optimization_tpu.ops.compression import make_compressor
+
+
+def _init(x0, config, *, neighbor_sum=None) -> State:
+    return {"x": x0, "xhat": jnp.zeros_like(x0)}
+
+
+def _step(state: State, ctx: StepContext) -> State:
+    cfg = ctx.config
+    x, xhat = state["x"], state["xhat"]
+    comp = make_compressor(cfg.compression, x.shape[-1], cfg.compression_k)
+
+    g = ctx.grad(x, 0)
+    x_half = x - ctx.eta * g
+    # Distinct counter-based stream for the (possibly randomized) compressor.
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.key(cfg.seed), 0xC0C0), ctx.t
+    )
+    q = comp.apply(key, x_half - xhat)
+    xhat_new = xhat + q
+    x_new = x_half + cfg.choco_gamma * (ctx.mix(xhat_new) - xhat_new)
+    return {"x": x_new, "xhat": xhat_new}
+
+
+def _comm_payload(config, d: int) -> float:
+    return make_compressor(config.compression, d, config.compression_k).floats_per_edge
+
+
+CHOCO = register_algorithm(
+    Algorithm(
+        name="choco",
+        init=_init,
+        step=_step,
+        gossip_rounds=1,
+        comm_payload=_comm_payload,
+    )
+)

@@ -208,9 +208,16 @@ def run(
                 topo, impl=config.mixing_impl, dtype=device_data.X.dtype
             )
         degrees = jnp.asarray(topo.degrees, dtype=device_data.X.dtype)[:, None]
-        floats_per_iter = decentralized_floats_per_iteration(
-            topo, device_data.n_features, algo.gossip_rounds
-        )
+        # Per-edge payload: d · gossip_rounds for full-vector exchange, or the
+        # algorithm's override (compressed gossip transmits less).
+        if algo.comm_payload is not None:
+            edge_payload = algo.comm_payload(config, device_data.n_features)
+            floats_per_iter = topo.floats_per_iteration * edge_payload
+        else:
+            edge_payload = device_data.n_features * algo.gossip_rounds
+            floats_per_iter = decentralized_floats_per_iteration(
+                topo, device_data.n_features, algo.gossip_rounds
+            )
         spectral_gap = topo.spectral_gap
         if config.edge_drop_prob > 0.0:
             if config.mixing_impl == "shard_map":
@@ -290,6 +297,7 @@ def run(
         collect_metrics and algo.is_decentralized and config.record_consensus
     )
     eval_every = config.eval_every
+    scan_unroll = config.resolved_scan_unroll(jax.devices()[0].platform)
 
     def step(state, t):
         if faulty is not None:
@@ -315,7 +323,9 @@ def run(
         # metric evaluation — the eval-cadence knob SURVEY.md §7 hard part (b)
         # calls for (the reference evaluates every iteration; k=1 reproduces
         # that exactly).
-        state, _ = jax.lax.scan(step, state, ts)
+        state, _ = jax.lax.scan(
+            step, state, ts, unroll=min(scan_unroll, eval_every)
+        )
         out = {}
         if collect_metrics:
             x = state["x"]
@@ -329,9 +339,7 @@ def run(
             # so it costs one tiny mask redraw per iteration, no extra
             # communication).
             out["floats"] = (
-                jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts))
-                * device_data.n_features
-                * algo.gossip_rounds
+                jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts)) * edge_payload
             )
         return state, out
 
@@ -340,7 +348,7 @@ def run(
     if checkpoint is None:
         def run_scan(state_init):
             ts = jnp.arange(T, dtype=jnp.int32).reshape(n_evals, eval_every)
-            return jax.lax.scan(chunk, state_init, ts)
+            return jax.lax.scan(chunk, state_init, ts, unroll=scan_unroll)
 
         # AOT compile so compile time and steady-state execution are separable
         # (jax.profiler-style phase split, SURVEY.md §5.1).

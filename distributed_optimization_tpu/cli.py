@@ -29,12 +29,34 @@ from typing import Optional, Sequence
 from distributed_optimization_tpu.config import (
     ALGORITHMS,
     BACKENDS,
+    COMPRESSIONS,
     PROBLEM_TYPES,
     TOPOLOGIES,
     ExperimentConfig,
 )
 
 _DEFAULTS = ExperimentConfig()
+
+# The five target configurations named in BASELINE.json, as CLI presets.
+# Flags given alongside --preset still override individual fields.
+PRESETS: dict[str, dict] = {
+    # 1. Quadratic consensus, 4 workers, fully-connected — DGD
+    "quadratic-fc-4": dict(problem_type="quadratic", algorithm="dsgd",
+                           topology="fully_connected", n_workers=4),
+    # 2. Logistic regression, synthetic data, 8-worker ring — DGD
+    "logistic-ring-8": dict(problem_type="logistic", algorithm="dsgd",
+                            topology="ring", n_workers=8),
+    # 3. Decentralized ADMM, logistic, 16-worker Erdős–Rényi graph
+    "admm-er-16": dict(problem_type="logistic", algorithm="admm",
+                       topology="erdos_renyi", n_workers=16),
+    # 4. Gradient tracking / EXTRA, quadratic, 64-worker 2D torus
+    "gt-torus-64": dict(problem_type="quadratic", algorithm="gradient_tracking",
+                        topology="grid", n_workers=64,
+                        learning_rate_eta0=0.01),
+    # 5. Decentralized logistic on real image features, 256 workers (stretch)
+    "digits-256": dict(problem_type="logistic", algorithm="dsgd",
+                       topology="ring", n_workers=256, dataset="digits"),
+}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -48,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     run = p.add_argument_group("run selection")
+    run.add_argument("--preset", choices=sorted(PRESETS), default=None,
+                     help="apply one of the BASELINE.json target configs; "
+                          "other flags still override individual fields")
     run.add_argument("--suite", action="store_true",
                      help="run the reference experiment matrix (centralized + "
                           "D-SGD over ring/grid/fully-connected) instead of a "
@@ -89,6 +114,14 @@ def build_parser() -> argparse.ArgumentParser:
     opt.add_argument("--admm-rho", type=float, default=_DEFAULTS.admm_rho)
     opt.add_argument("--erdos-renyi-p", type=float,
                      default=_DEFAULTS.erdos_renyi_p)
+    opt.add_argument("--compression", choices=COMPRESSIONS,
+                     default=_DEFAULTS.compression,
+                     help="CHOCO-SGD gossip compression operator")
+    opt.add_argument("--compression-k", type=int,
+                     default=_DEFAULTS.compression_k,
+                     help="coordinates kept per transmitted vector")
+    opt.add_argument("--choco-gamma", type=float, default=_DEFAULTS.choco_gamma,
+                     help="CHOCO consensus step size")
     opt.add_argument("--edge-drop-prob", type=float,
                      default=_DEFAULTS.edge_drop_prob,
                      help="failure injection: per-iteration probability that "
@@ -105,6 +138,9 @@ def build_parser() -> argparse.ArgumentParser:
     execg.add_argument("--mixing-impl",
                        choices=("auto", "dense", "stencil", "shard_map"),
                        default=_DEFAULTS.mixing_impl)
+    execg.add_argument("--scan-unroll", type=int, default=_DEFAULTS.scan_unroll,
+                       help="XLA unroll factor for the training scan "
+                            "(0 = auto: 8 on accelerators, 1 on CPU)")
     execg.add_argument("--dtype", choices=("float32", "float64", "bfloat16"),
                        default=_DEFAULTS.dtype)
     execg.add_argument("--matmul-precision",
@@ -160,18 +196,36 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         lr_schedule=args.lr_schedule,
         admm_c=args.admm_c,
         admm_rho=args.admm_rho,
+        compression=args.compression,
+        compression_k=args.compression_k,
+        choco_gamma=args.choco_gamma,
         seed=args.seed,
         eval_every=args.eval_every,
         erdos_renyi_p=args.erdos_renyi_p,
         edge_drop_prob=args.edge_drop_prob,
         mixing_impl=args.mixing_impl,
+        scan_unroll=args.scan_unroll,
         dtype=args.dtype,
         matmul_precision=args.matmul_precision,
     )
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.preset is not None:
+        # Preset values apply only to flags the user did not pass. Detection
+        # must not compare against defaults (an explicit flag set to its
+        # default value still wins): re-parse with all defaults suppressed so
+        # only command-line-provided dests appear.
+        aux = build_parser()
+        for action in aux._actions:
+            action.default = argparse.SUPPRESS
+        explicit = set(vars(aux.parse_args(argv)))
+        for field, value in PRESETS[args.preset].items():
+            if field not in explicit:
+                setattr(args, field, value)
 
     if args.platform != "auto":
         # Must run before any jax operation; overrides the TPU plugin's pin

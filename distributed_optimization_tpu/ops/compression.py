@@ -1,0 +1,81 @@
+"""Communication-compression operators for gossip algorithms.
+
+Not present in the reference (its gossip always exchanges full d-vectors,
+reference ``trainer.py:169-173``); this is the compressed-gossip capability
+from the same literature line the reference's report builds on (Koloskova,
+Stich & Jaggi '19 — report ref [13] authors — define CHOCO-SGD around exactly
+these operators).
+
+Each operator is a jittable contraction ``Q(key, v) -> v_compressed`` over
+the last axis of an ``[N, d]`` stack, together with its per-edge float cost
+(the analytic comms-accounting payload; index transmission is counted as one
+float per index, the accounting convention of the sparsification literature):
+
+- ``top_k``: keep the k largest-|magnitude| coordinates per row (biased,
+  contraction factor delta = k/d); cost 2k (k values + k indices).
+- ``random_k``: keep k uniformly random coordinates per row (unbiased after
+  (d/k)-rescaling in expectation, but used UNscaled inside CHOCO, which
+  requires only a contraction); cost 2k.
+- ``none``: identity; cost d.
+
+All operators satisfy the contraction property
+E‖v − Q(v)‖² ≤ (1 − delta)‖v‖², delta > 0 — the condition CHOCO's
+convergence proof needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_optimization_tpu.config import COMPRESSIONS
+
+
+@dataclasses.dataclass(frozen=True)
+class Compressor:
+    """A jittable row-wise compression operator with its comms payload."""
+
+    name: str
+    apply: Callable[[Optional[jax.Array], jax.Array], jax.Array]
+    floats_per_edge: float  # payload replacing d in the float accounting
+    delta: float  # contraction factor (k/d; 1 for identity)
+
+
+def make_compressor(name: str, d: int, k: int = 0) -> Compressor:
+    """Build a compressor for d-dimensional rows.
+
+    ``k`` (coordinates kept) is required for top_k/random_k; 0 < k <= d.
+    """
+    if name == "none":
+        return Compressor("none", lambda key, v: v, float(d), 1.0)
+    if name not in COMPRESSIONS:
+        raise ValueError(f"Unknown compression: {name!r}; known {COMPRESSIONS}")
+    if not 0 < k <= d:
+        raise ValueError(f"compression_k must be in (0, {d}], got {k}")
+
+    def keep_top_scored(v, scores):
+        # Row-wise mask keeping the k top-scored coordinates of each row.
+        _, idx = jax.lax.top_k(scores, k)
+        mask = jnp.zeros_like(v).at[
+            jnp.arange(v.shape[0])[:, None], idx
+        ].set(1.0)
+        return v * mask
+
+    if name == "top_k":
+
+        def apply_topk(key, v):
+            # Deterministic operator; key unused.
+            return keep_top_scored(v, jnp.abs(v))
+
+        return Compressor("top_k", apply_topk, 2.0 * k, k / d)
+
+    def apply_randk(key, v):
+        if key is None:
+            raise ValueError("random_k compression needs a PRNG key")
+        # Uniform scores = k uniformly random coordinates per row.
+        return keep_top_scored(v, jax.random.uniform(key, v.shape))
+
+    return Compressor("random_k", apply_randk, 2.0 * k, k / d)

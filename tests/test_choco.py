@@ -1,0 +1,158 @@
+"""CHOCO-SGD (compressed gossip) tests.
+
+Pinned properties: (a) the compression operators are contractions with the
+advertised payloads; (b) identity compression + gamma=1 reduces CHOCO exactly
+to adapt-then-combine D-SGD, W(x - eta*g); (c) top-k compressed runs still
+converge while transmitting a fraction of the floats; (d) comms accounting
+reflects the compressed payload.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.ops.compression import make_compressor
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+
+# ------------------------------------------------------------- compressors
+def test_topk_keeps_largest_and_payload():
+    comp = make_compressor("top_k", d=6, k=2)
+    v = jnp.asarray([[1.0, -5.0, 0.5, 4.0, 0.0, -0.1]])
+    got = np.asarray(comp.apply(None, v))
+    np.testing.assert_array_equal(got, [[0.0, -5.0, 0.0, 4.0, 0.0, 0.0]])
+    assert comp.floats_per_edge == 4.0  # k values + k indices
+    assert comp.delta == pytest.approx(2 / 6)
+
+
+def test_randomk_is_contraction_and_reproducible():
+    comp = make_compressor("random_k", d=20, k=5)
+    v = jnp.asarray(np.random.default_rng(0).standard_normal((7, 20)),
+                    dtype=jnp.float32)
+    key = jax.random.key(3)
+    a = np.asarray(comp.apply(key, v))
+    b = np.asarray(comp.apply(key, v))
+    np.testing.assert_array_equal(a, b)
+    assert np.all(np.count_nonzero(a, axis=1) <= 5)
+    # Contraction: ||v - Q(v)||^2 < ||v||^2 elementwise-masked operator.
+    assert np.sum((np.asarray(v) - a) ** 2) < np.sum(np.asarray(v) ** 2)
+
+
+def test_compressor_validation():
+    with pytest.raises(ValueError, match="compression_k"):
+        make_compressor("top_k", d=4, k=0)
+    with pytest.raises(ValueError, match="compression_k"):
+        make_compressor("random_k", d=4, k=5)
+    with pytest.raises(ValueError, match="Unknown compression"):
+        make_compressor("qsgd", d=4, k=2)
+    assert make_compressor("none", d=7).floats_per_edge == 7.0
+
+
+# ------------------------------------------------------------ the algorithm
+CFG = ExperimentConfig(
+    n_workers=9, n_samples=450, n_features=10, n_informative_features=6,
+    n_iterations=400, local_batch_size=8, problem_type="quadratic",
+    algorithm="choco", topology="ring", eval_every=40,
+    learning_rate_eta0=0.01, lr_schedule="constant",
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    return ds, f_opt
+
+
+def test_identity_gamma1_equals_adapt_then_combine_dsgd(data):
+    # One step from a shared nonzero-ish state: x1 = W (x0 - eta g(x0)).
+    from distributed_optimization_tpu.algorithms import get_algorithm
+    from distributed_optimization_tpu.algorithms.base import StepContext
+    from distributed_optimization_tpu.parallel import build_topology
+
+    n, d = 9, 5
+    topo = build_topology("ring", n)
+    W = jnp.asarray(topo.mixing_matrix, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.standard_normal((n, d)), dtype=jnp.float32)
+    g = jnp.asarray(rng.standard_normal((n, d)), dtype=jnp.float32)
+    cfg = CFG.replace(choco_gamma=1.0)
+
+    ctx = StepContext(
+        grad=lambda params, slot: g,
+        mix=lambda v: W @ v,
+        neighbor_sum=lambda v: v * 0,
+        eta=jnp.asarray(0.05),
+        t=jnp.asarray(0),
+        degrees=jnp.full((n, 1), 2.0),
+        config=cfg,
+    )
+    algo = get_algorithm("choco")
+    state = algo.init(x0, cfg)
+    # First step: xhat=0 so Q(x_half - 0) = x_half exactly (identity Q).
+    out = algo.step(state, ctx)["x"]
+    want = W @ (x0 - 0.05 * g)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_uncompressed_choco_converges(data):
+    ds, f_opt = data
+    r = jax_backend.run(CFG.replace(choco_gamma=1.0), ds, f_opt)
+    assert r.history.objective[-1] < 0.2 * r.history.objective[0]
+
+
+def test_topk_compressed_converges_with_fraction_of_floats(data):
+    ds, f_opt = data
+    d = CFG.n_features + 1  # 11
+    full = jax_backend.run(CFG.replace(choco_gamma=1.0), ds, f_opt)
+    comp = jax_backend.run(
+        CFG.replace(compression="top_k", compression_k=3, choco_gamma=0.25),
+        ds, f_opt,
+    )
+    # Transmits 2k/d of the floats...
+    assert comp.history.total_floats_transmitted == pytest.approx(
+        full.history.total_floats_transmitted * (2 * 3) / d
+    )
+    # ...and still optimizes.
+    assert comp.history.objective[-1] < 0.3 * comp.history.objective[0]
+    assert np.all(np.isfinite(comp.final_models))
+
+
+def test_randomk_compressed_converges(data):
+    ds, f_opt = data
+    r = jax_backend.run(
+        CFG.replace(compression="random_k", compression_k=4,
+                    choco_gamma=0.3),
+        ds, f_opt,
+    )
+    assert r.history.objective[-1] < 0.3 * r.history.objective[0]
+
+
+def test_choco_under_edge_faults(data):
+    # Mix-based rule: doubly stochastic W_t keeps CHOCO valid under faults.
+    ds, f_opt = data
+    r = jax_backend.run(
+        CFG.replace(compression="top_k", compression_k=4, choco_gamma=0.2,
+                    edge_drop_prob=0.2),
+        ds, f_opt,
+    )
+    assert np.all(np.isfinite(r.history.objective))
+    assert r.history.objective[-1] < 0.5 * r.history.objective[0]
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="compression_k"):
+        ExperimentConfig(algorithm="choco", compression="top_k")
+    with pytest.raises(ValueError, match="Unknown compression"):
+        ExperimentConfig(compression="qsgd")
+    with pytest.raises(ValueError, match="choco_gamma"):
+        ExperimentConfig(algorithm="choco", choco_gamma=0.0)
+    # Compression on a full-vector algorithm would be silently ignored;
+    # config rejects the combination outright.
+    with pytest.raises(ValueError, match="only takes effect"):
+        ExperimentConfig(algorithm="dsgd", compression="top_k", compression_k=3)

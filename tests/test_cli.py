@@ -52,6 +52,57 @@ def test_main_suite_with_plot(tmp_path):
     assert plot.exists() and plot.stat().st_size > 0
 
 
+def test_presets_cover_baseline_configs(tmp_path):
+    from distributed_optimization_tpu.cli import PRESETS
+
+    assert set(PRESETS) == {
+        "quadratic-fc-4", "logistic-ring-8", "admm-er-16", "gt-torus-64",
+        "digits-256",
+    }
+    # Preset end-to-end (tiny horizon), with an explicit flag overriding it.
+    json_out = tmp_path / "p.json"
+    rc = main(["--preset", "logistic-ring-8", "--n-iterations", "30",
+               "--n-samples", "400", "--n-features", "8",
+               "--n-informative-features", "4", "--quiet",
+               "--json", str(json_out)])
+    assert rc == 0
+    blob = json.loads(json_out.read_text())
+    assert blob["config"]["n_workers"] == 8
+    assert blob["config"]["n_iterations"] == 30  # explicit flag won
+
+
+def test_preset_explicit_default_value_wins(tmp_path):
+    # A flag explicitly set to its global-default value still beats the
+    # preset (detection must not compare values against defaults).
+    json_out = tmp_path / "p.json"
+    rc = main(["--preset", "gt-torus-64", "--learning-rate-eta0", "0.05",
+               "--n-iterations", "20", "--n-samples", "400",
+               "--n-features", "8", "--n-informative-features", "4",
+               "--quiet", "--json", str(json_out)])
+    assert rc == 0
+    blob = json.loads(json_out.read_text())
+    assert blob["config"]["learning_rate_eta0"] == 0.05  # not the preset's 0.01
+    assert blob["config"]["n_workers"] == 64  # preset still applied elsewhere
+
+
+def test_preset_admm_er(tmp_path):
+    rc = main(["--preset", "admm-er-16", "--n-iterations", "30",
+               "--n-samples", "400", "--n-features", "8",
+               "--n-informative-features", "4", "--quiet"])
+    assert rc == 0
+
+
+def test_main_choco_compressed(tmp_path):
+    json_out = tmp_path / "c.json"
+    rc = main(_TINY + ["--algorithm", "choco", "--compression", "top_k",
+                       "--compression-k", "3", "--choco-gamma", "0.3",
+                       "--json", str(json_out)])
+    assert rc == 0
+    blob = json.loads(json_out.read_text())
+    # ring: sum(deg)=2N, payload 2k=6 -> floats = 2N * 2k * T
+    assert blob["runs"][0]["total_transmission_floats"] == 9 * 2 * 6 * 30
+
+
 def test_main_digits_dataset(tmp_path):
     json_out = tmp_path / "d.json"
     rc = main(["--dataset", "digits", "--problem-type", "logistic",
