@@ -16,8 +16,12 @@ With identity compression and γ = 1 this is exactly D-SGD in its
 "adapt-then-combine" form, x^{t+1} = W (x^t − η g) (the property the tests
 pin down). The stacked form keeps X and X̂ as two [N, d] leaves; the estimate
 update is local, and (W − I) X̂ reuses the standard ``mix`` collective, so
-compression composes with every mixing implementation and with edge-failure
-injection (any doubly stochastic W_t preserves the analysis).
+compression composes with every mixing implementation. Edge-failure
+injection is rejected for CHOCO: a dropped edge means the neighbor's copy of
+x̂_j goes stale (it never received q_j), which the single shared X̂ leaf
+cannot represent — faithful modeling needs per-edge [N, N, d] staleness
+state, so rather than report fault-free convergence with fault-discounted
+bandwidth, the combination raises.
 
 Comms accounting: each edge carries the compressor's payload instead of d
 floats per iteration (``comm_payload``, consumed by the backends' float
@@ -70,5 +74,8 @@ CHOCO = register_algorithm(
         step=_step,
         gossip_rounds=1,
         comm_payload=_comm_payload,
+        # See module docstring: lost q deliveries imply per-neighbor stale
+        # estimate copies the shared-X̂ simulation cannot represent.
+        supports_edge_faults=False,
     )
 )

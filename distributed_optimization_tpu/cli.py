@@ -136,7 +136,8 @@ def build_parser() -> argparse.ArgumentParser:
                        help="full-data objective eval cadence (1 = reference "
                             "parity)")
     execg.add_argument("--mixing-impl",
-                       choices=("auto", "dense", "stencil", "shard_map"),
+                       choices=("auto", "dense", "stencil", "shard_map",
+                                "pallas"),
                        default=_DEFAULTS.mixing_impl)
     execg.add_argument("--scan-unroll", type=int, default=_DEFAULTS.scan_unroll,
                        help="XLA unroll factor for the training scan "

@@ -100,7 +100,8 @@ class ExperimentConfig:
             raise ValueError(f"Unknown topology: {self.topology}")
         if self.backend not in BACKENDS:
             raise ValueError(f"Unknown backend: {self.backend}")
-        if self.mixing_impl not in ("auto", "dense", "stencil", "shard_map"):
+        if self.mixing_impl not in ("auto", "dense", "stencil", "shard_map",
+                                    "pallas"):
             raise ValueError(f"Unknown mixing impl: {self.mixing_impl}")
         if self.lr_schedule not in ("auto", "sqrt_decay", "constant"):
             raise ValueError(f"Unknown lr schedule: {self.lr_schedule}")

@@ -75,10 +75,37 @@ def make_mixing_op(topo: Topology, impl: str = "auto", dtype=jnp.float32) -> Mix
             "shard_map mixing ops need a Mesh; build them via "
             "distributed_optimization_tpu.parallel.collectives instead"
         )
-    if impl not in ("dense", "stencil"):
+    if impl not in ("dense", "stencil", "pallas"):
         raise ValueError(f"Unknown mixing impl: {impl!r}")
     if impl == "stencil" and not _supports_stencil(topo):
         raise ValueError(f"stencil mixing unsupported for {topo.name} (n={topo.n})")
+
+    if impl == "pallas":
+        # Hand-fused VMEM kernels (ops/pallas_kernels.py). Ring and
+        # fully-connected only — the graphs whose uniform-MH stencils reduce
+        # to rolls/means of the whole [N, d] block.
+        from distributed_optimization_tpu.ops import pallas_kernels as pk
+
+        if topo.name == "ring" and topo.n >= 3:
+            return MixingOp(
+                topo.name,
+                "pallas",
+                pk.ring_mix,
+                # A x = 3·Wx − x for the degree-2 uniform ring stencil.
+                lambda x: 3.0 * pk.ring_mix(x) - x,
+            )
+        if topo.name == "fully_connected":
+            n = topo.n
+            return MixingOp(
+                topo.name,
+                "pallas",
+                pk.fc_mix,
+                lambda x: n * pk.fc_mix(x) - x,
+            )
+        raise ValueError(
+            f"pallas mixing supports ring (n>=3) and fully_connected, "
+            f"not {topo.name} (n={topo.n})"
+        )
 
     if impl == "dense":
         W = jnp.asarray(topo.mixing_matrix, dtype=dtype)
