@@ -41,6 +41,9 @@ class StepContext:
     ``eta``: learning rate for this iteration (scalar).
     ``degrees``: [N, 1] node degrees.
     ``config``: the ExperimentConfig (static hyperparameters only).
+    ``fused_mix_step``: optional backend-provided fusion of the canonical
+    gossip-SGD update, (x, g, eta) -> W x − eta g in one kernel (the pallas
+    fast path); algorithms whose update IS that form may use it when present.
     """
 
     grad: Callable[[Array, int], Array]
@@ -50,6 +53,7 @@ class StepContext:
     t: Array
     degrees: Array
     config: Any
+    fused_mix_step: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

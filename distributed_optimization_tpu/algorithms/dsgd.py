@@ -31,6 +31,9 @@ def _init(x0, config, *, neighbor_sum=None) -> State:
 def _step(state: State, ctx: StepContext) -> State:
     x = state["x"]
     grads = ctx.grad(x, 0)  # at the local pre-mix models (D-PSGD ordering)
+    if ctx.fused_mix_step is not None:
+        # Backend-fused W x − eta g (single pallas kernel, one HBM pass).
+        return {"x": ctx.fused_mix_step(x, grads, ctx.eta)}
     x_new = ctx.mix(x) - ctx.eta * grads
     return {"x": x_new}
 

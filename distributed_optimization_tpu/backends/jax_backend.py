@@ -71,6 +71,20 @@ def make_full_objective_fn(problem, X, y, n_valid, reg):
     return full_objective
 
 
+def _fetch_to_host(tree):
+    """Bring possibly sharded device arrays to host numpy.
+
+    In a multi-process (multi-host) run the worker axis spans
+    non-addressable devices, so a plain np.asarray would raise; gather the
+    full value on every host first. Single-process runs skip the gather.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        tree = multihost_utils.process_allgather(tree, tiled=True)
+    return jax.tree.map(np.asarray, tree)
+
+
 def _make_eta_fn(config):
     eta0 = config.learning_rate_eta0
     if config.resolved_lr_schedule() == "sqrt_decay":
@@ -144,7 +158,7 @@ def _run_checkpointed(
         done = c + 1
         if done % checkpoint.every_evals == 0 or done == n_evals:
             ckptr.save(
-                done, jax.device_get(state), gap_list, cons_list, floats_list
+                done, _fetch_to_host(state), gap_list, cons_list, floats_list
             )
     state = jax.block_until_ready(state)
     run_seconds = time.perf_counter() - t1
@@ -306,6 +320,22 @@ def run(
     inner_unroll = min(scan_unroll, eval_every)
     outer_unroll = max(1, scan_unroll // eval_every)
 
+    # The pallas ring kernel fuses the whole canonical gossip-SGD update;
+    # offer it to algorithms via the context (dsgd uses it).
+    fused_mix_step = None
+    if (
+        faulty is None
+        and mix_op is not None
+        and mix_op.impl == "pallas"
+        and topo is not None
+        and topo.name == "ring"
+    ):
+        from distributed_optimization_tpu.ops.pallas_kernels import (
+            fused_ring_dsgd_step,
+        )
+
+        fused_mix_step = fused_ring_dsgd_step
+
     def step(state, t):
         if faulty is not None:
             mix_fn = lambda v: faulty.mix(t, v)  # noqa: E731
@@ -322,6 +352,7 @@ def run(
             t=t,
             degrees=degrees,
             config=config,
+            fused_mix_step=fused_mix_step,
         )
         return algo.step(state, ctx), None
 
@@ -392,7 +423,7 @@ def run(
     total_floats = (
         realized_floats if realized_floats is not None else floats_per_iter * T
     )
-    final_models = np.asarray(final_state["x"], dtype=np.float64)
+    final_models = _fetch_to_host(final_state["x"]).astype(np.float64)
 
     history = RunHistory(
         objective=gap_hist,

@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--platform", choices=("tpu", "cpu", "auto"), default="auto",
                      help="force the JAX platform (cpu is useful for quick "
                           "checks and virtual multi-device runs)")
+    run.add_argument("--multihost", action="store_true",
+                     help="call jax.distributed.initialize() so the worker "
+                          "mesh spans all hosts of a multi-host TPU slice "
+                          "(run the same command on every host; coordinator "
+                          "discovery via the standard TPU env vars)")
 
     prob = p.add_argument_group("problem / data (reference main.py parity)")
     prob.add_argument("--problem-type", choices=PROBLEM_TYPES,
@@ -235,6 +240,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.multihost:
+        # Multi-host slice: every host runs this same process; jax wires the
+        # global device mesh over ICI within a slice (and DCN across slices),
+        # and the worker-axis sharding + collectives need no other changes.
+        import jax
+
+        try:
+            jax.distributed.initialize()
+        except ValueError as e:
+            raise SystemExit(
+                f"--multihost: jax.distributed.initialize() failed ({e}). "
+                "On Cloud TPU slices the coordinator is auto-discovered; "
+                "elsewhere set JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / "
+                "JAX_PROCESS_ID, or omit --multihost on a single host."
+            ) from e
+        if not args.quiet:
+            print(
+                f"[cli] multihost: process {jax.process_index()} of "
+                f"{jax.process_count()}, {len(jax.devices())} global devices",
+                file=sys.stderr,
+            )
 
     # Grid in the suite is skipped gracefully for non-square N, but a single
     # run with an invalid combination should fail fast in config validation.

@@ -88,19 +88,11 @@ def make_mixing_op(topo: Topology, impl: str = "auto", dtype=jnp.float32) -> Mix
 
         if topo.name == "ring" and topo.n >= 3:
             return MixingOp(
-                topo.name,
-                "pallas",
-                pk.ring_mix,
-                # A x = 3·Wx − x for the degree-2 uniform ring stencil.
-                lambda x: 3.0 * pk.ring_mix(x) - x,
+                topo.name, "pallas", pk.ring_mix, pk.ring_neighbor_sum
             )
         if topo.name == "fully_connected":
-            n = topo.n
             return MixingOp(
-                topo.name,
-                "pallas",
-                pk.fc_mix,
-                lambda x: n * pk.fc_mix(x) - x,
+                topo.name, "pallas", pk.fc_mix, pk.fc_neighbor_sum
             )
         raise ValueError(
             f"pallas mixing supports ring (n>=3) and fully_connected, "

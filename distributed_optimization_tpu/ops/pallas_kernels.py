@@ -19,7 +19,6 @@ fully-connected topologies; other graphs fall back with a clear error).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -59,16 +58,19 @@ def _fc_mix_kernel(x_ref, out_ref):
     out_ref[:] = jnp.broadcast_to(jnp.mean(x, axis=0, keepdims=True), x.shape)
 
 
-@functools.partial(jax.jit, static_argnames=())
+def _ring_neighbor_sum_kernel(x_ref, out_ref):
+    x = x_ref[:]
+    out_ref[:] = _roll(x, 1) + _roll(x, -1)
+
+
+def _fc_neighbor_sum_kernel(x_ref, out_ref):
+    x = x_ref[:]
+    out_ref[:] = jnp.broadcast_to(jnp.sum(x, axis=0, keepdims=True), x.shape) - x
+
+
 def ring_mix(x: jax.Array) -> jax.Array:
     """W x for a ring of N >= 3 workers; [N, d] -> [N, d], one VMEM pass."""
-    return pl.pallas_call(
-        _ring_mix_kernel,
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=_on_cpu(),
-    )(x)
+    return _unary_call(_ring_mix_kernel, x)
 
 
 def fused_ring_dsgd_step(x: jax.Array, g: jax.Array, eta) -> jax.Array:
@@ -87,12 +89,26 @@ def fused_ring_dsgd_step(x: jax.Array, g: jax.Array, eta) -> jax.Array:
     )(eta_arr, x, g)
 
 
-def fc_mix(x: jax.Array) -> jax.Array:
-    """W x for the fully-connected graph: the global mean, one VMEM pass."""
+def _unary_call(kernel, x: jax.Array) -> jax.Array:
     return pl.pallas_call(
-        _fc_mix_kernel,
+        kernel,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=_on_cpu(),
     )(x)
+
+
+def fc_mix(x: jax.Array) -> jax.Array:
+    """W x for the fully-connected graph: the global mean, one VMEM pass."""
+    return _unary_call(_fc_mix_kernel, x)
+
+
+def ring_neighbor_sum(x: jax.Array) -> jax.Array:
+    """A x for the ring: roll(+1) + roll(−1), computed directly (exact)."""
+    return _unary_call(_ring_neighbor_sum_kernel, x)
+
+
+def fc_neighbor_sum(x: jax.Array) -> jax.Array:
+    """A x for the fully-connected graph: column sums minus self."""
+    return _unary_call(_fc_neighbor_sum_kernel, x)

@@ -1,0 +1,94 @@
+"""Hypothesis property tests for the math-critical invariants.
+
+Broader input coverage than the example-based suites: every topology's
+mixing matrix must be symmetric, row-stochastic, and average-preserving for
+ANY valid (topology, N); the fault-realized matrices must keep those
+properties for ANY drop probability; compression must always be a
+contraction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from distributed_optimization_tpu.ops.compression import make_compressor
+from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.parallel.faults import (
+    metropolis_hastings_weights,
+    sample_surviving_adjacency,
+)
+
+SETTINGS = dict(max_examples=25, deadline=None)
+
+
+def _check_mixing_matrix(W: np.ndarray, atol: float = 1e-9):
+    np.testing.assert_allclose(W, W.T, atol=atol)
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=atol)
+    assert np.all(W >= -atol)
+    # Average preservation: (1/N) 1^T W x == (1/N) 1^T x for all x.
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((W.shape[0], 3))
+    np.testing.assert_allclose((W @ x).mean(0), x.mean(0), atol=max(atol, 1e-7) * 100)
+
+
+@settings(**SETTINGS)
+@given(
+    topology=st.sampled_from(["ring", "fully_connected", "chain", "star",
+                              "erdos_renyi"]),
+    n=st.integers(min_value=3, max_value=40),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_mixing_matrix_invariants(topology, n, seed):
+    topo = build_topology(topology, n, erdos_renyi_p=0.5, seed=seed)
+    _check_mixing_matrix(topo.mixing_matrix)
+    assert 0.0 <= topo.spectral_gap <= 1.0 + 1e-9
+
+
+@settings(**SETTINGS)
+@given(side=st.integers(min_value=3, max_value=7))
+def test_grid_mixing_matrix_invariants(side):
+    topo = build_topology("grid", side * side)
+    _check_mixing_matrix(topo.mixing_matrix)
+
+
+@settings(**SETTINGS)
+@given(
+    n=st.integers(min_value=3, max_value=24),
+    drop=st.floats(min_value=0.0, max_value=0.95),
+    t=st.integers(min_value=0, max_value=10_000),
+)
+def test_fault_realized_matrix_invariants(n, drop, t):
+    topo = build_topology("fully_connected", n)
+    key = jax.random.fold_in(jax.random.key(9), t)
+    At = sample_surviving_adjacency(
+        key, jnp.asarray(topo.adjacency, dtype=jnp.float32), drop
+    )
+    # float32 device dtype: row sums accurate to ~1e-6.
+    _check_mixing_matrix(
+        np.asarray(metropolis_hastings_weights(At), dtype=np.float64),
+        atol=1e-5,
+    )
+
+
+@settings(**SETTINGS)
+@given(
+    d=st.integers(min_value=2, max_value=64),
+    data=st.data(),
+    name=st.sampled_from(["top_k", "random_k"]),
+)
+def test_compression_is_contraction(d, data, name):
+    k = data.draw(st.integers(min_value=1, max_value=d))
+    comp = make_compressor(name, d=d, k=k)
+    rng = np.random.default_rng(d * 1000 + k)
+    v = jnp.asarray(rng.standard_normal((5, d)), dtype=jnp.float32)
+    q = np.asarray(comp.apply(jax.random.key(0), v))
+    # Contraction: ||v - Q(v)||^2 <= (1 - k/d)||v||^2 row-wise for top_k;
+    # for random_k the masked-out energy is at most the total energy.
+    err = np.sum((np.asarray(v) - q) ** 2, axis=1)
+    total = np.sum(np.asarray(v) ** 2, axis=1)
+    if name == "top_k":
+        assert np.all(err <= (1 - k / d) * total + 1e-5)
+    else:
+        assert np.all(err <= total + 1e-6)
+    assert np.all(np.count_nonzero(q, axis=1) <= k)
