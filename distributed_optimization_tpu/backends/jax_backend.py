@@ -20,6 +20,7 @@ loops end to end.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Optional
 
@@ -185,6 +186,36 @@ def run(
 ) -> BackendRunResult:
     """Run one experiment on the JAX backend; returns histories + final models.
 
+    A float64 config runs under a scoped ``enable_x64`` — without it jax
+    silently truncates every array to float32, defeating the fidelity dtype.
+    """
+    scope = (
+        jax.enable_x64()
+        if config.dtype == "float64" and not jax.config.jax_enable_x64
+        else contextlib.nullcontext()
+    )
+    with scope:
+        return _run(
+            config, dataset, f_opt, mesh=mesh, use_mesh=use_mesh,
+            batch_schedule=batch_schedule, collect_metrics=collect_metrics,
+            measure_compile=measure_compile, checkpoint=checkpoint,
+        )
+
+
+def _run(
+    config,
+    dataset: HostDataset,
+    f_opt: float,
+    *,
+    mesh=None,
+    use_mesh: bool = True,
+    batch_schedule: Optional[np.ndarray] = None,
+    collect_metrics: bool = True,
+    measure_compile: bool = True,
+    checkpoint=None,
+) -> BackendRunResult:
+    """Backend implementation (see ``run``).
+
     ``mesh``: an explicit ``jax.sharding.Mesh`` (1-D, axis 'workers');
     ``use_mesh=True`` builds one over all visible devices that evenly divide
     N. ``batch_schedule [T, N, b]`` injects fixed batch indices (equivalence
@@ -233,16 +264,16 @@ def run(
                 topo, device_data.n_features, algo.gossip_rounds
             )
         spectral_gap = topo.spectral_gap
-        if config.edge_drop_prob > 0.0:
+        if config.edge_drop_prob > 0.0 or config.straggler_prob > 0.0:
             if config.mixing_impl == "shard_map":
                 raise ValueError(
-                    "edge_drop_prob requires dense/stencil mixing: the "
+                    "fault injection requires dense/stencil mixing: the "
                     "shard_map stencils assume the static uniform-weight "
                     "topology (use mixing_impl='dense' for fault injection)"
                 )
             if not algo.supports_edge_faults:
                 raise ValueError(
-                    f"edge_drop_prob is unsupported for {algo.name!r}: the "
+                    f"fault injection is unsupported for {algo.name!r}: the "
                     "step rule is not faithful under dropped edges (ADMM "
                     "pairs neighbor sums with static degrees; CHOCO's shared "
                     "estimate state cannot represent undelivered updates)"
@@ -250,15 +281,16 @@ def run(
             faulty = make_faulty_mixing(
                 topo, config.edge_drop_prob, config.seed,
                 dtype=device_data.X.dtype,
+                straggler_prob=config.straggler_prob,
             )
         else:
             faulty = None
     else:
-        if config.edge_drop_prob > 0.0:
+        if config.edge_drop_prob > 0.0 or config.straggler_prob > 0.0:
             raise ValueError(
-                "edge_drop_prob models gossip-link failures and applies only "
-                "to decentralized algorithms; the centralized pattern has no "
-                "peer edges to drop"
+                "fault injection models gossip-peer failures and applies "
+                "only to decentralized algorithms; the centralized pattern "
+                "has no peer edges to drop"
             )
         topo = None
         mix_op = None
@@ -302,6 +334,7 @@ def run(
                 Xb, yb, wts = sample_worker_batches(
                     slot_key, t, X, y, n_valid, batch_size
                 )
+                wts = wts.astype(X.dtype)  # keep bf16 carries unpromoted
             return jax.vmap(
                 problem.gradient_weighted, in_axes=(0, 0, 0, 0, None)
             )(params, Xb, yb, wts, reg)
@@ -348,13 +381,28 @@ def run(
             grad=grad_fn_factory(t),
             mix=mix_fn,
             neighbor_sum=nbr_fn,
-            eta=eta_fn(t),
+            # Cast to the run dtype so low-precision carries (bfloat16)
+            # aren't silently promoted by the f32 schedule scalar.
+            eta=eta_fn(t).astype(X.dtype),
             t=t,
             degrees=degrees,
             config=config,
             fused_mix_step=fused_mix_step,
         )
-        return algo.step(state, ctx), None
+        new_state = algo.step(state, ctx)
+        if faulty is not None and faulty.straggler_prob > 0.0:
+            # A straggler takes no step at all: freeze its rows across every
+            # state leaf (each leaf leads with the worker axis). Its mixing
+            # row already degenerated to identity via the dropped edges.
+            m = faulty.active(t)
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(
+                    m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old
+                ),
+                new_state,
+                state,
+            )
+        return new_state, None
 
     def chunk(state, ts):
         # ``eval_every`` iterations of pure optimization, then one on-device

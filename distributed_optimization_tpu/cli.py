@@ -132,6 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="failure injection: per-iteration probability that "
                           "each topology edge drops (gossip reweights on the "
                           "surviving graph)")
+    opt.add_argument("--straggler-prob", type=float,
+                     default=_DEFAULTS.straggler_prob,
+                     help="straggler injection: per-iteration probability "
+                          "that a node sits the round out (no exchange, no "
+                          "local step)")
     opt.add_argument("--seed", type=int, default=_DEFAULTS.seed)
     opt.add_argument("--suboptimality-threshold", type=float,
                      default=_DEFAULTS.suboptimality_threshold)
@@ -209,6 +214,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         eval_every=args.eval_every,
         erdos_renyi_p=args.erdos_renyi_p,
         edge_drop_prob=args.edge_drop_prob,
+        straggler_prob=args.straggler_prob,
         mixing_impl=args.mixing_impl,
         scan_unroll=args.scan_unroll,
         dtype=args.dtype,

@@ -79,6 +79,10 @@ class ExperimentConfig:
     # each edge of the topology drops; gossip runs over the surviving graph
     # with MH weights recomputed on realized degrees. 0 = no faults.
     edge_drop_prob: float = 0.0
+    # Straggler/node-failure injection: per-iteration iid probability that a
+    # node sits the round out — it exchanges nothing and takes no local
+    # step (its state is frozen for that iteration). 0 = none.
+    straggler_prob: float = 0.0
     mixing_impl: str = "auto"  # 'auto' | 'dense' | 'stencil' | 'shard_map'
     # XLA scan unrolling for the jax backend's training loop. The per-worker
     # kernels here are tiny, so a single TPU chip is loop-dispatch-bound;
@@ -126,6 +130,10 @@ class ExperimentConfig:
         if not 0.0 <= self.edge_drop_prob < 1.0:
             raise ValueError(
                 f"edge_drop_prob must be in [0, 1), got {self.edge_drop_prob}"
+            )
+        if not 0.0 <= self.straggler_prob < 1.0:
+            raise ValueError(
+                f"straggler_prob must be in [0, 1), got {self.straggler_prob}"
             )
         if self.dtype not in ("float32", "float64", "bfloat16"):
             raise ValueError(f"Unknown dtype: {self.dtype}")

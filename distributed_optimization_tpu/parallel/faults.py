@@ -1,20 +1,28 @@
-"""Failure injection: time-varying gossip over randomly dropped edges.
+"""Failure injection: time-varying gossip over dropped edges and stragglers.
 
 The reference has no failure model — its synchronous lockstep loop cannot
 lose a worker (SURVEY.md §5.3); its report only *discusses* the parameter
-server as a single point of failure. Here link failure is a first-class,
-jit-compatible simulation: each iteration, every edge of the base topology
-independently drops with probability ``drop_prob`` (a symmetric draw — both
-endpoints agree the link is down), and gossip runs over the surviving graph
-with Metropolis–Hastings weights recomputed on the realized degrees. This is
-the time-varying-graph setting of Koloskova et al. '20 (reference report
-ref [13]): W_t stays symmetric and doubly stochastic for every realization,
-so the network average is preserved and D-SGD/GT/EXTRA remain convergent
-under their time-varying-gossip analyses.
+server as a single point of failure. Here two failure modes are first-class,
+jit-compatible simulations:
 
-Edge masks are derived purely from (fault key, iteration) — like batch
-sampling, fault realizations are reproducible and checkpoint/resume-safe with
-no carried RNG state.
+- **link failure** (``drop_prob``): each iteration, every edge of the base
+  topology independently drops with probability p (a symmetric draw — both
+  endpoints agree the link is down);
+- **stragglers / node failure** (``straggler_prob``): each iteration, every
+  node independently sits the round out with probability q — it exchanges
+  nothing (all incident edges drop) and, in the backend, its state is frozen
+  for the iteration (no local gradient step either).
+
+Gossip runs over the surviving graph with Metropolis–Hastings weights
+recomputed on realized degrees; an isolated or inactive node's row collapses
+to identity. This is the time-varying-graph setting of Koloskova et al. '20
+(reference report ref [13]): W_t stays symmetric and doubly stochastic for
+every realization, so the network average is preserved and D-SGD/GT/EXTRA
+remain convergent under their time-varying-gossip analyses.
+
+Masks are derived purely from (fault key, iteration) — like batch sampling,
+fault realizations are reproducible and checkpoint/resume-safe with no
+carried RNG state.
 """
 
 from __future__ import annotations
@@ -34,15 +42,18 @@ class FaultyMixing:
 
     ``mix(t, x)``: W_t x with W_t the MH matrix of the surviving graph.
     ``neighbor_sum(t, x)``: A_t x over surviving edges.
-    ``realized_floats(t)``: floats a simulator would count as transmitted at
-    iteration t (Σ realized deg_i · d is the caller's job — this returns
-    Σ realized deg_i; multiply by d and gossip rounds downstream).
+    ``realized_degree_sum(t)``: Σ realized deg_i at iteration t (multiply by
+    the per-edge payload downstream for the floats-transmitted metric).
+    ``active(t)``: [N] 0/1 node-participation mask (all-ones when
+    straggler_prob == 0); the backend freezes inactive rows for the step.
     """
 
     mix: Callable[[jax.Array, jax.Array], jax.Array]
     neighbor_sum: Callable[[jax.Array, jax.Array], jax.Array]
     realized_degree_sum: Callable[[jax.Array], jax.Array]
+    active: Callable[[jax.Array], jax.Array]
     drop_prob: float
+    straggler_prob: float
 
 
 def sample_surviving_adjacency(key, adjacency: jax.Array, drop_prob: float):
@@ -70,18 +81,38 @@ def metropolis_hastings_weights(adjacency: jax.Array) -> jax.Array:
 
 
 def make_faulty_mixing(
-    topo: Topology, drop_prob: float, seed: int, dtype=jnp.float32
+    topo: Topology,
+    drop_prob: float,
+    seed: int,
+    dtype=jnp.float32,
+    straggler_prob: float = 0.0,
 ) -> FaultyMixing:
     """Build time-varying mixing operators for a base topology."""
     if not 0.0 <= drop_prob < 1.0:
         raise ValueError(f"drop_prob must be in [0, 1), got {drop_prob}")
+    if not 0.0 <= straggler_prob < 1.0:
+        raise ValueError(
+            f"straggler_prob must be in [0, 1), got {straggler_prob}"
+        )
     base_A = jnp.asarray(topo.adjacency, dtype=dtype)
-    # Distinct stream from batch sampling: fold a tag into the seed key.
+    # Distinct streams from batch sampling: fold tags into the seed key.
     fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
+    node_key = jax.random.fold_in(jax.random.key(seed), 0x57A66)
+
+    def active(t) -> jax.Array:
+        if straggler_prob == 0.0:
+            return jnp.ones(base_A.shape[0], dtype=dtype)
+        key = jax.random.fold_in(node_key, t)
+        u = jax.random.uniform(key, (base_A.shape[0],))
+        return (u >= straggler_prob).astype(dtype)
 
     def realized_adjacency(t) -> jax.Array:
         key = jax.random.fold_in(fault_key, t)
-        return sample_surviving_adjacency(key, base_A, drop_prob)
+        A_t = sample_surviving_adjacency(key, base_A, drop_prob)
+        if straggler_prob > 0.0:
+            m = active(t)
+            A_t = A_t * m[:, None] * m[None, :]  # straggler exchanges nothing
+        return A_t
 
     def mix(t, x):
         W = metropolis_hastings_weights(realized_adjacency(t))
@@ -97,5 +128,7 @@ def make_faulty_mixing(
         mix=mix,
         neighbor_sum=neighbor_sum,
         realized_degree_sum=realized_degree_sum,
+        active=active,
         drop_prob=drop_prob,
+        straggler_prob=straggler_prob,
     )

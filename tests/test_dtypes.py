@@ -1,0 +1,75 @@
+"""dtype-path regression tests: float64 fidelity and bfloat16 TPU dtype.
+
+float64 requires a scoped x64 enable — without it jax silently truncates to
+float32 (the bug this file pins); bfloat16 is the MXU-native storage dtype
+and must run end to end.
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+BASE = ExperimentConfig(
+    n_workers=8, n_samples=320, n_features=8, n_informative_features=4,
+    n_iterations=100, local_batch_size=8, problem_type="quadratic",
+    algorithm="dsgd", topology="ring", eval_every=10,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    ds = generate_synthetic_dataset(BASE)
+    _, f_opt = compute_reference_optimum(ds, BASE.reg_param)
+    return ds, f_opt
+
+
+def test_float64_runs_without_truncation_warnings(data):
+    ds, f_opt = data
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # truncation warns
+        r = jax_backend.run(BASE.replace(dtype="float64"), ds, f_opt)
+    assert np.all(np.isfinite(r.history.objective))
+    assert not jax.config.jax_enable_x64  # scope restored
+
+
+def test_float64_more_accurate_than_float32(data):
+    ds, f_opt = data
+    import numpy as onp
+
+    from distributed_optimization_tpu.backends import numpy_backend
+
+    T = 60
+    sched = onp.stack([
+        onp.stack([
+            onp.random.default_rng(1000 * t + i).choice(40, size=8,
+                                                        replace=False)
+            for i in range(BASE.n_workers)
+        ])
+        for t in range(T)
+    ]).astype(onp.int32)
+    cfg = BASE.replace(n_iterations=T, eval_every=T)
+    oracle = numpy_backend.run(cfg, ds, f_opt, batch_schedule=sched)
+    r64 = jax_backend.run(cfg.replace(dtype="float64"), ds, f_opt,
+                          batch_schedule=sched)
+    r32 = jax_backend.run(cfg, ds, f_opt, batch_schedule=sched)
+    err64 = np.abs(r64.final_models - oracle.final_models).max()
+    err32 = np.abs(r32.final_models - oracle.final_models).max()
+    assert err64 < err32  # float64 tracks the float64 oracle more closely
+    assert err64 < 1e-9
+
+
+def test_bfloat16_runs_and_optimizes(data):
+    ds, f_opt = data
+    r = jax_backend.run(
+        BASE.replace(dtype="bfloat16", n_iterations=300, eval_every=30),
+        ds, f_opt,
+    )
+    assert np.all(np.isfinite(r.history.objective))
+    assert r.history.objective[-1] < r.history.objective[0]

@@ -111,6 +111,65 @@ def test_shard_map_mixing_rejects_faults():
         )
 
 
+def test_straggler_adjacency_and_mean_preservation():
+    topo = build_topology("fully_connected", 10)
+    fm = make_faulty_mixing(topo, 0.0, seed=4, straggler_prob=0.4)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((10, 3)),
+                    dtype=jnp.float32)
+    for t in range(4):
+        m = np.asarray(fm.active(jnp.asarray(t)))
+        assert set(np.unique(m)).issubset({0.0, 1.0})
+        # Straggler exchanges nothing: its mixing row is identity.
+        mixed = np.asarray(fm.mix(jnp.asarray(t), x))
+        frozen = m == 0.0
+        np.testing.assert_allclose(
+            mixed[frozen], np.asarray(x)[frozen], atol=1e-6
+        )
+        # Doubly stochastic every realization: average preserved.
+        np.testing.assert_allclose(mixed.mean(0), np.asarray(x).mean(0),
+                                   atol=1e-5)
+
+
+def test_straggler_rows_frozen_in_backend():
+    from distributed_optimization_tpu.parallel.faults import make_faulty_mixing
+
+    cfg = CFG.replace(straggler_prob=0.5, n_iterations=1, eval_every=1)
+    ds = generate_synthetic_dataset(cfg)
+    r = jax_backend.run(cfg, ds, 0.0)
+    topo = build_topology("ring", cfg.n_workers)
+    fm = make_faulty_mixing(topo, 0.0, seed=cfg.seed, straggler_prob=0.5)
+    m = np.asarray(fm.active(jnp.asarray(0)))
+    # x0 = 0: stragglers must still be exactly zero, active rows moved.
+    assert np.all(r.final_models[m == 0.0] == 0.0)
+    if (m == 1.0).any():
+        assert np.all(np.abs(r.final_models[m == 1.0]).sum(axis=1) > 0)
+
+
+def test_dsgd_converges_under_stragglers():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    clean = jax_backend.run(CFG, ds, f_opt)
+    lazy = jax_backend.run(CFG.replace(straggler_prob=0.3), ds, f_opt)
+    assert lazy.history.objective[-1] < 0.2 * lazy.history.objective[0]
+    # Stragglers reduce realized communication: (1-q)^2 per edge ≈ 0.49.
+    assert (
+        lazy.history.total_floats_transmitted
+        < 0.7 * clean.history.total_floats_transmitted
+    )
+
+
+def test_straggler_rejected_for_centralized_and_numpy():
+    ds = generate_synthetic_dataset(CFG)
+    with pytest.raises(ValueError, match="decentralized"):
+        jax_backend.run(
+            CFG.replace(algorithm="centralized", straggler_prob=0.2), ds, 0.0
+        )
+    with pytest.raises(ValueError, match="jax-backend capability"):
+        numpy_backend.run(CFG.replace(straggler_prob=0.2), ds, 0.0)
+    with pytest.raises(ValueError):
+        ExperimentConfig(straggler_prob=1.0)
+
+
 def test_admm_rejects_faults():
     ds = generate_synthetic_dataset(CFG)
     with pytest.raises(ValueError, match="static degree"):
