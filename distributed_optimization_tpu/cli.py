@@ -124,7 +124,8 @@ def build_parser() -> argparse.ArgumentParser:
                      help="CHOCO-SGD gossip compression operator")
     opt.add_argument("--compression-k", type=int,
                      default=_DEFAULTS.compression_k,
-                     help="coordinates kept per transmitted vector")
+                     help="coordinates kept per transmitted vector "
+                          "(top_k/random_k) or quantization bits (qsgd)")
     opt.add_argument("--choco-gamma", type=float, default=_DEFAULTS.choco_gamma,
                      help="CHOCO consensus step size")
     opt.add_argument("--edge-drop-prob", type=float,
@@ -152,6 +153,9 @@ def build_parser() -> argparse.ArgumentParser:
     execg.add_argument("--scan-unroll", type=int, default=_DEFAULTS.scan_unroll,
                        help="XLA unroll factor for the training scan "
                             "(0 = auto: 8 on accelerators, 1 on CPU)")
+    execg.add_argument("--compile-cache", metavar="DIR", default=None,
+                       help="enable jax's persistent compilation cache in "
+                            "DIR (repeat runs skip the 5-30s XLA compile)")
     execg.add_argument("--dtype", choices=("float32", "float64", "bfloat16"),
                        default=_DEFAULTS.dtype)
     execg.add_argument("--matmul-precision",
@@ -246,6 +250,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.compile_cache:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     if args.multihost:
         # Multi-host slice: every host runs this same process; jax wires the

@@ -27,7 +27,7 @@ BACKENDS = ("jax", "numpy", "cpp")
 
 # Gossip-compression operators (CHOCO-SGD); implemented in ops/compression.py,
 # which derives from this constant (config stays jax-free).
-COMPRESSIONS = ("none", "top_k", "random_k")
+COMPRESSIONS = ("none", "top_k", "random_k", "qsgd")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,10 +66,13 @@ class ExperimentConfig:
     # data here, ≈ 0.25 for logistic). 5.0 is safe for both study problems.
     admm_rho: float = 5.0
     # CHOCO-SGD (compressed gossip) knobs: the compression operator applied
-    # to transmitted model differences, its kept-coordinate count, and the
-    # consensus step size gamma (stability needs roughly gamma <= delta =
-    # compression_k / d).
-    compression: str = "none"  # 'none' | 'top_k' | 'random_k'
+    # to transmitted model differences (see COMPRESSIONS), its parameter
+    # (coordinates kept for top_k/random_k; quantization BITS for qsgd), and
+    # the consensus step size gamma. Stability needs roughly gamma <= the
+    # operator's contraction factor delta: k/d for top_k/random_k,
+    # 1/(1+min(d/s^2, sqrt(d)/s)) with s = 2^bits for qsgd (reported as
+    # Compressor.delta by ops.compression.make_compressor).
+    compression: str = "none"
     compression_k: int = 0
     choco_gamma: float = 0.3
     seed: int = 203  # reference seeds np.random.seed(203) at main.py:24
@@ -120,8 +123,8 @@ class ExperimentConfig:
                 )
             if self.compression_k <= 0:
                 raise ValueError(
-                    "compression_k (coordinates kept) must be positive when "
-                    f"compression={self.compression!r}"
+                    "compression_k (coordinates kept, or qsgd bits) must be "
+                    f"positive when compression={self.compression!r}"
                 )
         if self.algorithm == "choco" and not 0.0 < self.choco_gamma <= 1.0:
             raise ValueError(

@@ -108,6 +108,7 @@ class NumericalResult:
     avg_worker_transmission_floats: float
     spectral_gap: Optional[float] = None
     iters_per_second: float = float("nan")
+    seconds_to_threshold: float = float("nan")  # wall clock; nan = never
 
 
 def summarize_run(
@@ -117,9 +118,19 @@ def summarize_run(
     n_workers: int,
     spectral_gap: Optional[float] = None,
 ) -> NumericalResult:
-    iters = iterations_to_threshold(
-        history.objective, threshold, history.eval_iterations
+    # One derivation of the threshold-crossing row serves both metrics.
+    below = (
+        np.nonzero(history.objective <= threshold)[0]
+        if history.objective.size else np.empty(0, dtype=int)
     )
+    if below.size:
+        row = int(below[0])
+        iters = int(history.eval_iterations[row])
+        seconds = (
+            float(history.time[row]) if row < history.time.size else float("nan")
+        )
+    else:
+        iters, seconds = -1, float("nan")
     total = history.total_floats_transmitted
     return NumericalResult(
         label=label,
@@ -128,4 +139,5 @@ def summarize_run(
         avg_worker_transmission_floats=total / n_workers if n_workers else 0.0,
         spectral_gap=spectral_gap,
         iters_per_second=history.iters_per_second,
+        seconds_to_threshold=seconds,
     )

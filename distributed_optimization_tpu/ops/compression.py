@@ -16,6 +16,10 @@ float per index, the accounting convention of the sparsification literature):
 - ``random_k``: keep k uniformly random coordinates per row (unbiased after
   (d/k)-rescaling in expectation, but used UNscaled inside CHOCO, which
   requires only a contraction); cost 2k.
+- ``qsgd``: stochastic uniform quantization to s = 2^b levels per row
+  (Alistarh et al. '17 as used by CHOCO: ‖v‖·sign(v)·ξ(v,s) with the
+  1/(1+min(d/s², √d/s)) scaling that makes it a contraction); cost counted
+  as d·(b+1)/32 + 1 floats per edge (b+1 bits per coordinate + the norm).
 - ``none``: identity; cost d.
 
 All operators satisfy the contraction property
@@ -26,6 +30,7 @@ convergence proof needs.
 from __future__ import annotations
 
 import dataclasses
+from math import sqrt as np_sqrt
 from typing import Callable, Optional
 
 import jax
@@ -47,12 +52,40 @@ class Compressor:
 def make_compressor(name: str, d: int, k: int = 0) -> Compressor:
     """Build a compressor for d-dimensional rows.
 
-    ``k`` (coordinates kept) is required for top_k/random_k; 0 < k <= d.
+    ``k``: coordinates kept for top_k/random_k (0 < k <= d); quantization
+    BITS per coordinate for qsgd (1 <= k <= 16).
     """
     if name == "none":
         return Compressor("none", lambda key, v: v, float(d), 1.0)
     if name not in COMPRESSIONS:
         raise ValueError(f"Unknown compression: {name!r}; known {COMPRESSIONS}")
+
+    if name == "qsgd":
+        if not 1 <= k <= 16:
+            raise ValueError(f"qsgd bits (compression_k) must be in [1, 16], got {k}")
+        s = float(2 ** k)  # quantization levels
+        # QSGD variance bound omega_var = min(d/s^2, sqrt(d)/s); scaling the
+        # unbiased quantizer by omega = 1/(1 + omega_var) makes it a
+        # contraction with delta = omega (Koloskova et al. '19, Sec. 2):
+        # E||v - omega*xi(v)||^2 <= (1 - omega)||v||^2.
+        omega = 1.0 / (1.0 + min(d / (s * s), np_sqrt(d) / s))
+
+        def apply_qsgd(key, v):
+            if key is None:
+                raise ValueError("qsgd compression needs a PRNG key")
+            norm = jnp.linalg.norm(v, axis=-1, keepdims=True)
+            scale = jnp.where(norm > 0, norm, 1.0)
+            level = jnp.abs(v) / scale * s  # in [0, s]
+            low = jnp.floor(level)
+            p_up = level - low  # stochastic rounding
+            u = jax.random.uniform(key, v.shape)
+            q = (low + (u < p_up)) / s
+            return omega * norm * jnp.sign(v) * q
+
+        bits_per_coord = k + 1  # sign + k magnitude bits
+        floats_cost = d * bits_per_coord / 32.0 + 1.0  # + the row norm
+        return Compressor("qsgd", apply_qsgd, floats_cost, omega)
+
     if not 0 < k <= d:
         raise ValueError(f"compression_k must be in (0, {d}], got {k}")
 

@@ -34,8 +34,8 @@ def format_report(records, config, f_opt: float) -> str:
         "=" * 78,
     ]
     header = (
-        f"{'run':<28}{'iters→ε':>9}{'floats total':>14}{'floats/worker':>15}"
-        f"{'1−ρ':>8}{'iters/s':>10}"
+        f"{'run':<28}{'iters→ε':>9}{'sec→ε':>8}{'floats total':>14}"
+        f"{'floats/worker':>15}{'1−ρ':>8}{'iters/s':>10}"
     )
     lines += [header, "-" * len(header)]
     for rec in records:
@@ -44,9 +44,11 @@ def format_report(records, config, f_opt: float) -> str:
             continue
         s = rec.summary
         iters = str(s.iterations_to_threshold) if s.iterations_to_threshold > 0 else "never"
+        secs = f"{s.seconds_to_threshold:.2f}" if np.isfinite(s.seconds_to_threshold) else "—"
         gap = f"{s.spectral_gap:.4f}" if s.spectral_gap is not None else "—"
         lines.append(
-            f"{rec.label:<28}{iters:>9}{_fmt_sci(s.total_transmission_floats):>14}"
+            f"{rec.label:<28}{iters:>9}{secs:>8}"
+            f"{_fmt_sci(s.total_transmission_floats):>14}"
             f"{_fmt_sci(s.avg_worker_transmission_floats):>15}{gap:>8}"
             f"{s.iters_per_second:>10.1f}"
         )

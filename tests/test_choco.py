@@ -42,13 +42,47 @@ def test_randomk_is_contraction_and_reproducible():
     assert np.sum((np.asarray(v) - a) ** 2) < np.sum(np.asarray(v) ** 2)
 
 
+def test_qsgd_unbiased_up_to_contraction_scale():
+    comp = make_compressor("qsgd", d=16, k=4)
+    v = jnp.asarray(np.random.default_rng(5).standard_normal((3, 16)),
+                    dtype=jnp.float32)
+    # E[Q(v)] = omega * v (the quantizer is unbiased before the omega scale).
+    samples = np.mean(
+        [np.asarray(comp.apply(jax.random.key(i), v)) for i in range(400)],
+        axis=0,
+    )
+    np.testing.assert_allclose(samples, comp.delta * np.asarray(v),
+                               rtol=0.1, atol=0.02)
+    # Payload: d*(bits+1)/32 + norm float.
+    assert comp.floats_per_edge == pytest.approx(16 * 5 / 32 + 1)
+    assert 0 < comp.delta <= 1
+
+
+def test_qsgd_zero_vector_stable():
+    comp = make_compressor("qsgd", d=8, k=2)
+    z = jnp.zeros((2, 8), dtype=jnp.float32)
+    out = np.asarray(comp.apply(jax.random.key(0), z))
+    assert np.all(out == 0.0)
+
+
+def test_qsgd_choco_converges(data):
+    ds, f_opt = data
+    r = jax_backend.run(
+        CFG.replace(compression="qsgd", compression_k=6, choco_gamma=0.5),
+        ds, f_opt,
+    )
+    assert r.history.objective[-1] < 0.3 * r.history.objective[0]
+
+
 def test_compressor_validation():
     with pytest.raises(ValueError, match="compression_k"):
         make_compressor("top_k", d=4, k=0)
     with pytest.raises(ValueError, match="compression_k"):
         make_compressor("random_k", d=4, k=5)
+    with pytest.raises(ValueError, match="qsgd bits"):
+        make_compressor("qsgd", d=4, k=0)
     with pytest.raises(ValueError, match="Unknown compression"):
-        make_compressor("qsgd", d=4, k=2)
+        make_compressor("signsgd", d=4, k=2)
     assert make_compressor("none", d=7).floats_per_edge == 7.0
 
 
@@ -150,7 +184,7 @@ def test_config_validation():
     with pytest.raises(ValueError, match="compression_k"):
         ExperimentConfig(algorithm="choco", compression="top_k")
     with pytest.raises(ValueError, match="Unknown compression"):
-        ExperimentConfig(compression="qsgd")
+        ExperimentConfig(compression="signsgd")
     with pytest.raises(ValueError, match="choco_gamma"):
         ExperimentConfig(algorithm="choco", choco_gamma=0.0)
     # Compression on a full-vector algorithm would be silently ignored;
