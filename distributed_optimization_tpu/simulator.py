@@ -196,8 +196,11 @@ class Simulator:
                 row["skipped"] = rec.skipped_reason
             else:
                 assert rec.summary is not None and rec.result is not None
+                secs = rec.summary.seconds_to_threshold
                 row.update(
                     iterations_to_threshold=rec.summary.iterations_to_threshold,
+                    # None (not NaN) when never reached: strict-JSON friendly.
+                    seconds_to_threshold=None if np.isnan(secs) else secs,
                     total_transmission_floats=rec.summary.total_transmission_floats,
                     avg_worker_transmission_floats=(
                         rec.summary.avg_worker_transmission_floats
