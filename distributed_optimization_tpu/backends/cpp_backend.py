@@ -108,8 +108,12 @@ def run(
             f"cpp backend implements {_SUPPORTED} (the reference's algorithm "
             f"set); {config.algorithm!r} is a jax-backend capability"
         )
-    if config.edge_drop_prob > 0.0 or config.straggler_prob > 0.0:
-        raise ValueError("failure injection is jax-only")
+    if (
+        config.edge_drop_prob > 0.0
+        or config.straggler_prob > 0.0
+        or config.gossip_schedule != "synchronous"
+    ):
+        raise ValueError("failure injection / one-peer gossip is jax-only")
     lib = load_library()
 
     n = config.n_workers

@@ -264,33 +264,44 @@ def _run(
                 topo, device_data.n_features, algo.gossip_rounds
             )
         spectral_gap = topo.spectral_gap
-        if config.edge_drop_prob > 0.0 or config.straggler_prob > 0.0:
+        time_varying = (
+            config.edge_drop_prob > 0.0
+            or config.straggler_prob > 0.0
+            or config.gossip_schedule == "one_peer"
+        )
+        if time_varying:
             if config.mixing_impl == "shard_map":
                 raise ValueError(
-                    "fault injection requires dense/stencil mixing: the "
-                    "shard_map stencils assume the static uniform-weight "
-                    "topology (use mixing_impl='dense' for fault injection)"
+                    "fault injection / one-peer gossip requires dense or "
+                    "stencil mixing: the shard_map stencils assume the "
+                    "static uniform-weight topology"
                 )
             if not algo.supports_edge_faults:
                 raise ValueError(
-                    f"fault injection is unsupported for {algo.name!r}: the "
-                    "step rule is not faithful under dropped edges (ADMM "
-                    "pairs neighbor sums with static degrees; CHOCO's shared "
-                    "estimate state cannot represent undelivered updates)"
+                    f"time-varying gossip is unsupported for {algo.name!r}: "
+                    "the step rule is not faithful under per-iteration "
+                    "graphs (ADMM pairs neighbor sums with static degrees; "
+                    "CHOCO's shared estimate state cannot represent "
+                    "undelivered updates)"
                 )
             faulty = make_faulty_mixing(
                 topo, config.edge_drop_prob, config.seed,
                 dtype=device_data.X.dtype,
                 straggler_prob=config.straggler_prob,
+                one_peer=config.gossip_schedule == "one_peer",
             )
         else:
             faulty = None
     else:
-        if config.edge_drop_prob > 0.0 or config.straggler_prob > 0.0:
+        if (
+            config.edge_drop_prob > 0.0
+            or config.straggler_prob > 0.0
+            or config.gossip_schedule == "one_peer"
+        ):
             raise ValueError(
-                "fault injection models gossip-peer failures and applies "
-                "only to decentralized algorithms; the centralized pattern "
-                "has no peer edges to drop"
+                "fault injection / one-peer gossip model peer exchanges and "
+                "apply only to decentralized algorithms; the centralized "
+                "pattern has no peer edges"
             )
         topo = None
         mix_op = None

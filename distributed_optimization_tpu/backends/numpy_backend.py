@@ -53,11 +53,15 @@ def run(
             f"numpy backend implements {_SUPPORTED} (the reference's algorithm "
             f"set); {config.algorithm!r} is a jax-backend capability"
         )
-    if config.edge_drop_prob > 0.0 or config.straggler_prob > 0.0:
+    if (
+        config.edge_drop_prob > 0.0
+        or config.straggler_prob > 0.0
+        or config.gossip_schedule != "synchronous"
+    ):
         raise ValueError(
-            "failure injection (edge_drop_prob/straggler_prob) is a "
-            "jax-backend capability; the numpy oracle mirrors the "
-            "reference's fault-free semantics"
+            "failure injection / one-peer gossip is a jax-backend "
+            "capability; the numpy oracle mirrors the reference's "
+            "fault-free synchronous semantics"
         )
     algo = get_algorithm(config.algorithm)
     T = config.n_iterations

@@ -133,6 +133,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="failure injection: per-iteration probability that "
                           "each topology edge drops (gossip reweights on the "
                           "surviving graph)")
+    opt.add_argument("--gossip-schedule", choices=("synchronous", "one_peer"),
+                     default=_DEFAULTS.gossip_schedule,
+                     help="'one_peer' = Boyd-style randomized gossip: each "
+                          "node pairwise-averages with at most one random "
+                          "neighbor per iteration")
     opt.add_argument("--straggler-prob", type=float,
                      default=_DEFAULTS.straggler_prob,
                      help="straggler injection: per-iteration probability "
@@ -219,6 +224,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         erdos_renyi_p=args.erdos_renyi_p,
         edge_drop_prob=args.edge_drop_prob,
         straggler_prob=args.straggler_prob,
+        gossip_schedule=args.gossip_schedule,
         mixing_impl=args.mixing_impl,
         scan_unroll=args.scan_unroll,
         dtype=args.dtype,

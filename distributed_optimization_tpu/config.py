@@ -86,6 +86,11 @@ class ExperimentConfig:
     # node sits the round out — it exchanges nothing and takes no local
     # step (its state is frozen for that iteration). 0 = none.
     straggler_prob: float = 0.0
+    # Gossip schedule: 'synchronous' averages with all (surviving) neighbors
+    # per iteration; 'one_peer' is Boyd-style randomized gossip — each node
+    # exchanges with at most ONE mutually-proposing random neighbor, W_t =
+    # 0.5(I + P_t). Composes with edge/straggler injection.
+    gossip_schedule: str = "synchronous"
     mixing_impl: str = "auto"  # 'auto' | 'dense' | 'stencil' | 'shard_map'
     # XLA scan unrolling for the jax backend's training loop. The per-worker
     # kernels here are tiny, so a single TPU chip is loop-dispatch-bound;
@@ -137,6 +142,10 @@ class ExperimentConfig:
         if not 0.0 <= self.straggler_prob < 1.0:
             raise ValueError(
                 f"straggler_prob must be in [0, 1), got {self.straggler_prob}"
+            )
+        if self.gossip_schedule not in ("synchronous", "one_peer"):
+            raise ValueError(
+                f"Unknown gossip schedule: {self.gossip_schedule}"
             )
         if self.dtype not in ("float32", "float64", "bfloat16"):
             raise ValueError(f"Unknown dtype: {self.dtype}")

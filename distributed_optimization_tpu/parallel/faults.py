@@ -13,12 +13,23 @@ jit-compatible simulations:
   nothing (all incident edges drop) and, in the backend, its state is frozen
   for the iteration (no local gradient step either).
 
-Gossip runs over the surviving graph with Metropolis–Hastings weights
-recomputed on realized degrees; an isolated or inactive node's row collapses
-to identity. This is the time-varying-graph setting of Koloskova et al. '20
-(reference report ref [13]): W_t stays symmetric and doubly stochastic for
-every realization, so the network average is preserved and D-SGD/GT/EXTRA
-remain convergent under their time-varying-gossip analyses.
+A third *scheduling* mode shares the machinery:
+
+- **one-peer randomized gossip** (``one_peer=True``): instead of averaging
+  with ALL surviving neighbors, each node proposes one uniformly random
+  neighbor and an edge activates iff the proposal is mutual (Boyd et al.
+  '06 randomized gossip, pairwise-averaging form). The realized W_t is
+  0.5·(I + P_t) for the involution P_t of matched pairs — each node
+  exchanges at most ONE model per iteration, the extreme
+  communication-frugality point of the gossip spectrum.
+
+Synchronous gossip runs over the surviving graph with Metropolis–Hastings
+weights recomputed on realized degrees; an isolated or inactive node's row
+collapses to identity. Either way this is the time-varying-graph setting of
+Koloskova et al. '20 (reference report ref [13]): W_t stays symmetric and
+doubly stochastic for every realization, so the network average is preserved
+and D-SGD/GT/EXTRA remain convergent under their time-varying-gossip
+analyses.
 
 Masks are derived purely from (fault key, iteration) — like batch sampling,
 fault realizations are reproducible and checkpoint/resume-safe with no
@@ -80,12 +91,27 @@ def metropolis_hastings_weights(adjacency: jax.Array) -> jax.Array:
     return W + jnp.diag(1.0 - jnp.sum(W, axis=1))
 
 
+def sample_one_peer_matching(key, adjacency: jax.Array) -> jax.Array:
+    """Mutual-proposal random matching: partner[i] (an involution; self if
+    unmatched). Each node proposes a uniformly random neighbor; an edge
+    activates iff both endpoints proposed each other."""
+    n = adjacency.shape[0]
+    idx = jnp.arange(n)
+    scores = jax.random.uniform(key, adjacency.shape) * adjacency
+    prop = jnp.argmax(scores, axis=1)
+    # Isolated rows (all-zero scores) would spuriously propose node 0.
+    prop = jnp.where(jnp.sum(adjacency, axis=1) > 0, prop, idx)
+    mutual = prop[prop] == idx
+    return jnp.where(mutual, prop, idx)
+
+
 def make_faulty_mixing(
     topo: Topology,
     drop_prob: float,
     seed: int,
     dtype=jnp.float32,
     straggler_prob: float = 0.0,
+    one_peer: bool = False,
 ) -> FaultyMixing:
     """Build time-varying mixing operators for a base topology."""
     if not 0.0 <= drop_prob < 1.0:
@@ -107,6 +133,8 @@ def make_faulty_mixing(
         return (u >= straggler_prob).astype(dtype)
 
     def realized_adjacency(t) -> jax.Array:
+        if drop_prob == 0.0 and straggler_prob == 0.0:
+            return base_A  # no fault sampling on the fault-free fast path
         key = jax.random.fold_in(fault_key, t)
         A_t = sample_surviving_adjacency(key, base_A, drop_prob)
         if straggler_prob > 0.0:
@@ -114,15 +142,42 @@ def make_faulty_mixing(
             A_t = A_t * m[:, None] * m[None, :]  # straggler exchanges nothing
         return A_t
 
-    def mix(t, x):
-        W = metropolis_hastings_weights(realized_adjacency(t))
-        return jnp.tensordot(W, x, axes=1).astype(x.dtype)
+    match_key = jax.random.fold_in(jax.random.key(seed), 0x3A7C4)
 
-    def neighbor_sum(t, x):
-        return jnp.tensordot(realized_adjacency(t), x, axes=1).astype(x.dtype)
+    def partner(t) -> jax.Array:
+        key = jax.random.fold_in(match_key, t)
+        return sample_one_peer_matching(key, realized_adjacency(t))
 
-    def realized_degree_sum(t):
-        return jnp.sum(realized_adjacency(t))
+    if one_peer:
+        def mix(t, x):
+            # W_t = 0.5 (I + P_t): pairwise averaging with the matched peer.
+            return (0.5 * (x + x[partner(t)])).astype(x.dtype)
+
+        def neighbor_sum(t, x):
+            p = partner(t)
+            matched = (p != jnp.arange(p.shape[0])).astype(x.dtype)
+            return (x[p] * matched.reshape((-1,) + (1,) * (x.ndim - 1))).astype(
+                x.dtype
+            )
+
+        def realized_degree_sum(t):
+            # Float like the synchronous branch: the downstream floats
+            # accounting multiplies by the payload and sums over chunks,
+            # which would overflow int32 at scale.
+            p = partner(t)
+            return jnp.sum((p != jnp.arange(p.shape[0])).astype(dtype))
+    else:
+        def mix(t, x):
+            W = metropolis_hastings_weights(realized_adjacency(t))
+            return jnp.tensordot(W, x, axes=1).astype(x.dtype)
+
+        def neighbor_sum(t, x):
+            return jnp.tensordot(realized_adjacency(t), x, axes=1).astype(
+                x.dtype
+            )
+
+        def realized_degree_sum(t):
+            return jnp.sum(realized_adjacency(t))
 
     return FaultyMixing(
         mix=mix,

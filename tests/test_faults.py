@@ -105,7 +105,7 @@ def test_numpy_backend_rejects_faults():
 
 def test_shard_map_mixing_rejects_faults():
     ds = generate_synthetic_dataset(CFG)
-    with pytest.raises(ValueError, match="dense/stencil"):
+    with pytest.raises(ValueError, match="dense or stencil"):
         jax_backend.run(
             CFG.replace(edge_drop_prob=0.1, mixing_impl="shard_map"), ds, 0.0
         )
@@ -168,6 +168,76 @@ def test_straggler_rejected_for_centralized_and_numpy():
         numpy_backend.run(CFG.replace(straggler_prob=0.2), ds, 0.0)
     with pytest.raises(ValueError):
         ExperimentConfig(straggler_prob=1.0)
+
+
+def test_one_peer_matching_properties():
+    from distributed_optimization_tpu.parallel.faults import (
+        sample_one_peer_matching,
+    )
+
+    topo = build_topology("grid", 16)
+    A = jnp.asarray(topo.adjacency, dtype=jnp.float32)
+    idx = np.arange(16)
+    for t in range(6):
+        p = np.asarray(sample_one_peer_matching(jax.random.key(t), A))
+        np.testing.assert_array_equal(p[p], idx)  # involution
+        matched = p != idx
+        # Matched pairs are real edges of the base graph.
+        assert np.all(np.asarray(A)[idx[matched], p[matched]] == 1.0)
+
+
+def test_one_peer_mix_is_pairwise_average_and_mean_preserving():
+    topo = build_topology("ring", 12)
+    fm = make_faulty_mixing(topo, 0.0, seed=8, one_peer=True)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((12, 4)),
+                    dtype=jnp.float32)
+    for t in range(4):
+        mixed = np.asarray(fm.mix(jnp.asarray(t), x))
+        np.testing.assert_allclose(mixed.mean(0), np.asarray(x).mean(0),
+                                   atol=1e-5)
+        # Every row is either itself (unmatched) or a pairwise average.
+        xs = np.asarray(x)
+        for i in range(12):
+            is_self = np.allclose(mixed[i], xs[i], atol=1e-6)
+            is_avg = np.any([
+                np.allclose(mixed[i], 0.5 * (xs[i] + xs[j]), atol=1e-6)
+                for j in range(12) if j != i
+            ])
+            assert is_self or is_avg
+        # Floats: one model per matched node, at most N.
+        assert float(fm.realized_degree_sum(jnp.asarray(t))) <= 12
+
+
+def test_one_peer_dsgd_converges_with_fraction_of_comm():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    sync = jax_backend.run(CFG, ds, f_opt)
+    op = jax_backend.run(CFG.replace(gossip_schedule="one_peer"), ds, f_opt)
+    assert op.history.objective[-1] < 0.2 * op.history.objective[0]
+    # <= N/sum(deg) = half the synchronous-ring traffic, strictly less.
+    assert (
+        op.history.total_floats_transmitted
+        < 0.55 * sync.history.total_floats_transmitted
+    )
+
+
+def test_one_peer_rejections():
+    ds = generate_synthetic_dataset(CFG)
+    with pytest.raises(ValueError, match="decentralized"):
+        jax_backend.run(
+            CFG.replace(algorithm="centralized", gossip_schedule="one_peer"),
+            ds, 0.0,
+        )
+    with pytest.raises(ValueError, match="time-varying"):
+        jax_backend.run(
+            CFG.replace(algorithm="admm", gossip_schedule="one_peer",
+                        lr_schedule="constant"),
+            ds, 0.0,
+        )
+    with pytest.raises(ValueError, match="jax-backend capability"):
+        numpy_backend.run(CFG.replace(gossip_schedule="one_peer"), ds, 0.0)
+    with pytest.raises(ValueError, match="Unknown gossip"):
+        ExperimentConfig(gossip_schedule="async")
 
 
 def test_admm_rejects_faults():
