@@ -39,7 +39,10 @@ from distributed_optimization_tpu.metrics import (
 from distributed_optimization_tpu.models import get_problem
 from distributed_optimization_tpu.ops.mixing import make_mixing_op
 from distributed_optimization_tpu.ops.sampling import sample_worker_batches
-from distributed_optimization_tpu.parallel.faults import make_faulty_mixing
+from distributed_optimization_tpu.parallel.faults import (
+    make_faulty_mixing,
+    make_round_robin_mixing,
+)
 from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.parallel.collectives import make_shard_map_mixing_op
 from distributed_optimization_tpu.parallel.mesh import (
@@ -267,13 +270,13 @@ def _run(
         time_varying = (
             config.edge_drop_prob > 0.0
             or config.straggler_prob > 0.0
-            or config.gossip_schedule == "one_peer"
+            or config.gossip_schedule != "synchronous"
         )
         if time_varying:
             if config.mixing_impl == "shard_map":
                 raise ValueError(
-                    "fault injection / one-peer gossip requires dense or "
-                    "stencil mixing: the shard_map stencils assume the "
+                    "fault injection / matching-based gossip requires dense "
+                    "or stencil mixing: the shard_map stencils assume the "
                     "static uniform-weight topology"
                 )
             if not algo.supports_edge_faults:
@@ -284,24 +287,27 @@ def _run(
                     "CHOCO's shared estimate state cannot represent "
                     "undelivered updates)"
                 )
-            faulty = make_faulty_mixing(
-                topo, config.edge_drop_prob, config.seed,
-                dtype=device_data.X.dtype,
-                straggler_prob=config.straggler_prob,
-                one_peer=config.gossip_schedule == "one_peer",
-            )
+            if config.gossip_schedule == "round_robin":
+                faulty = make_round_robin_mixing(topo, device_data.X.dtype)
+            else:
+                faulty = make_faulty_mixing(
+                    topo, config.edge_drop_prob, config.seed,
+                    dtype=device_data.X.dtype,
+                    straggler_prob=config.straggler_prob,
+                    one_peer=config.gossip_schedule == "one_peer",
+                )
         else:
             faulty = None
     else:
         if (
             config.edge_drop_prob > 0.0
             or config.straggler_prob > 0.0
-            or config.gossip_schedule == "one_peer"
+            or config.gossip_schedule != "synchronous"
         ):
             raise ValueError(
-                "fault injection / one-peer gossip model peer exchanges and "
-                "apply only to decentralized algorithms; the centralized "
-                "pattern has no peer edges"
+                "fault injection / matching-based gossip model peer "
+                "exchanges and apply only to decentralized algorithms; the "
+                "centralized pattern has no peer edges"
             )
         topo = None
         mix_op = None

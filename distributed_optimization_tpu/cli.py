@@ -133,11 +133,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="failure injection: per-iteration probability that "
                           "each topology edge drops (gossip reweights on the "
                           "surviving graph)")
-    opt.add_argument("--gossip-schedule", choices=("synchronous", "one_peer"),
+    opt.add_argument("--gossip-schedule",
+                     choices=("synchronous", "one_peer", "round_robin"),
                      default=_DEFAULTS.gossip_schedule,
-                     help="'one_peer' = Boyd-style randomized gossip: each "
-                          "node pairwise-averages with at most one random "
-                          "neighbor per iteration")
+                     help="'one_peer' = randomized pairwise gossip (one "
+                          "random mutual neighbor/iter); 'round_robin' = "
+                          "deterministic matchings covering the edge set "
+                          "every P iterations")
     opt.add_argument("--straggler-prob", type=float,
                      default=_DEFAULTS.straggler_prob,
                      help="straggler injection: per-iteration probability "

@@ -89,7 +89,9 @@ class ExperimentConfig:
     # Gossip schedule: 'synchronous' averages with all (surviving) neighbors
     # per iteration; 'one_peer' is Boyd-style randomized gossip — each node
     # exchanges with at most ONE mutually-proposing random neighbor, W_t =
-    # 0.5(I + P_t). Composes with edge/straggler injection.
+    # 0.5(I + P_t), composable with edge/straggler injection; 'round_robin'
+    # cycles deterministic matchings that cover the edge set every P
+    # iterations (ring/chain/even-sided grid).
     gossip_schedule: str = "synchronous"
     mixing_impl: str = "auto"  # 'auto' | 'dense' | 'stencil' | 'shard_map'
     # XLA scan unrolling for the jax backend's training loop. The per-worker
@@ -143,9 +145,17 @@ class ExperimentConfig:
             raise ValueError(
                 f"straggler_prob must be in [0, 1), got {self.straggler_prob}"
             )
-        if self.gossip_schedule not in ("synchronous", "one_peer"):
+        if self.gossip_schedule not in ("synchronous", "one_peer",
+                                        "round_robin"):
             raise ValueError(
                 f"Unknown gossip schedule: {self.gossip_schedule}"
+            )
+        if self.gossip_schedule == "round_robin" and (
+            self.edge_drop_prob > 0.0 or self.straggler_prob > 0.0
+        ):
+            raise ValueError(
+                "round_robin is a deterministic schedule; combine failure "
+                "injection with 'synchronous' or 'one_peer' instead"
             )
         if self.dtype not in ("float32", "float64", "bfloat16"):
             raise ValueError(f"Unknown dtype: {self.dtype}")

@@ -91,6 +91,56 @@ def metropolis_hastings_weights(adjacency: jax.Array) -> jax.Array:
     return W + jnp.diag(1.0 - jnp.sum(W, axis=1))
 
 
+def _matching_ops(partner_fn, dtype):
+    """Mixing closures for any matching schedule given partner_fn(t).
+
+    W_t = 0.5 (I + P_t): pairwise averaging with the matched peer (identity
+    row for unmatched nodes). Shared by the one-peer randomized and
+    round-robin deterministic schedules.
+    """
+
+    def mix(t, x):
+        return (0.5 * (x + x[partner_fn(t)])).astype(x.dtype)
+
+    def neighbor_sum(t, x):
+        p = partner_fn(t)
+        matched = (p != jnp.arange(p.shape[0])).astype(x.dtype)
+        return (x[p] * matched.reshape((-1,) + (1,) * (x.ndim - 1))).astype(
+            x.dtype
+        )
+
+    def realized_degree_sum(t):
+        # Float like the synchronous branch: the downstream floats
+        # accounting multiplies by the payload and sums over chunks, which
+        # would overflow int32 at scale.
+        p = partner_fn(t)
+        return jnp.sum((p != jnp.arange(p.shape[0])).astype(dtype))
+
+    return mix, neighbor_sum, realized_degree_sum
+
+
+def make_round_robin_mixing(topo: Topology, dtype=jnp.float32) -> FaultyMixing:
+    """Deterministic matching schedule (``parallel/matchings.py`` phases) as
+    time-varying mixing ops, same interface as ``make_faulty_mixing``."""
+    from distributed_optimization_tpu.parallel.matchings import (
+        round_robin_partners,
+    )
+
+    partners = jnp.asarray(round_robin_partners(topo), dtype=jnp.int32)
+    n_phases, n = partners.shape
+    mix, neighbor_sum, realized_degree_sum = _matching_ops(
+        lambda t: partners[t % n_phases], dtype
+    )
+    return FaultyMixing(
+        mix=mix,
+        neighbor_sum=neighbor_sum,
+        realized_degree_sum=realized_degree_sum,
+        active=lambda t: jnp.ones(n, dtype=dtype),
+        drop_prob=0.0,
+        straggler_prob=0.0,
+    )
+
+
 def sample_one_peer_matching(key, adjacency: jax.Array) -> jax.Array:
     """Mutual-proposal random matching: partner[i] (an involution; self if
     unmatched). Each node proposes a uniformly random neighbor; an edge
@@ -149,23 +199,7 @@ def make_faulty_mixing(
         return sample_one_peer_matching(key, realized_adjacency(t))
 
     if one_peer:
-        def mix(t, x):
-            # W_t = 0.5 (I + P_t): pairwise averaging with the matched peer.
-            return (0.5 * (x + x[partner(t)])).astype(x.dtype)
-
-        def neighbor_sum(t, x):
-            p = partner(t)
-            matched = (p != jnp.arange(p.shape[0])).astype(x.dtype)
-            return (x[p] * matched.reshape((-1,) + (1,) * (x.ndim - 1))).astype(
-                x.dtype
-            )
-
-        def realized_degree_sum(t):
-            # Float like the synchronous branch: the downstream floats
-            # accounting multiplies by the payload and sums over chunks,
-            # which would overflow int32 at scale.
-            p = partner(t)
-            return jnp.sum((p != jnp.arange(p.shape[0])).astype(dtype))
+        mix, neighbor_sum, realized_degree_sum = _matching_ops(partner, dtype)
     else:
         def mix(t, x):
             W = metropolis_hastings_weights(realized_adjacency(t))
