@@ -43,5 +43,16 @@ def _step(state: State, ctx: StepContext) -> State:
 
 
 EXTRA = register_algorithm(
-    Algorithm(name="extra", init=_init, step=_step, gossip_rounds=1)
+    Algorithm(
+        name="extra",
+        init=_init,
+        step=_step,
+        gossip_rounds=1,
+        # EXTRA pairs this iteration's W_t x_t with the CARRIED previous mix
+        # W_{t-1} x_{t-1}; its exactness/fixed-point argument requires a
+        # static W. Unlike D-SGD and DIGing-style gradient tracking it has no
+        # time-varying-graph guarantee, so composing it with edge drops /
+        # matching schedules could silently converge to a biased point.
+        supports_edge_faults=False,
+    )
 )

@@ -118,7 +118,12 @@ def _run_checkpointed(
 
     eval_every = config.eval_every
     ckptr = RunCheckpointer(checkpoint)
-    ckptr.validate_or_record_config(config)
+    if checkpoint.resume:
+        ckptr.validate_or_record_config(config)
+    else:
+        # Explicit fresh start: clear stale chunks (they would poison a later
+        # resume) and rewrite the sidecar instead of validating against it.
+        ckptr.reset(config)
     ts_row0 = _replicate(mesh, jnp.arange(eval_every, dtype=jnp.int32))
 
     t0 = time.perf_counter()
@@ -285,14 +290,14 @@ def _run(
                     "the step rule is not faithful under per-iteration "
                     "graphs (ADMM pairs neighbor sums with static degrees; "
                     "CHOCO's shared estimate state cannot represent "
-                    "undelivered updates)"
+                    "undelivered updates; EXTRA's fixed-point argument "
+                    "requires a static W)"
                 )
             if config.gossip_schedule == "round_robin":
-                faulty = make_round_robin_mixing(topo, device_data.X.dtype)
+                faulty = make_round_robin_mixing(topo)
             else:
                 faulty = make_faulty_mixing(
                     topo, config.edge_drop_prob, config.seed,
-                    dtype=device_data.X.dtype,
                     straggler_prob=config.straggler_prob,
                     one_peer=config.gossip_schedule == "one_peer",
                 )

@@ -28,8 +28,17 @@ weights recomputed on realized degrees; an isolated or inactive node's row
 collapses to identity. Either way this is the time-varying-graph setting of
 Koloskova et al. '20 (reference report ref [13]): W_t stays symmetric and
 doubly stochastic for every realization, so the network average is preserved
-and D-SGD/GT/EXTRA remain convergent under their time-varying-gossip
-analyses.
+and D-SGD and DIGing-style gradient tracking remain convergent under their
+time-varying-gossip analyses. EXTRA does NOT compose (its fixed-point
+argument needs a static W — it is rejected alongside ADMM/CHOCO, see
+``Algorithm.supports_edge_faults``).
+
+Fault masks, realized adjacencies, MH weights, and the realized-floats
+accounting are always computed in float32 regardless of the run dtype:
+under bfloat16 (8 mantissa bits) edge counts above ~256 quantize and MH row
+sums pick up off-by-ulp mass, corrupting both the mixing invariants and the
+"honest" comms metric. Only the mixed MODEL values are cast to the run
+dtype.
 
 Masks are derived purely from (fault key, iteration) — like batch sampling,
 fault realizations are reproducible and checkpoint/resume-safe with no
@@ -91,7 +100,7 @@ def metropolis_hastings_weights(adjacency: jax.Array) -> jax.Array:
     return W + jnp.diag(1.0 - jnp.sum(W, axis=1))
 
 
-def _matching_ops(partner_fn, dtype):
+def _matching_ops(partner_fn):
     """Mixing closures for any matching schedule given partner_fn(t).
 
     W_t = 0.5 (I + P_t): pairwise averaging with the matched peer (identity
@@ -110,16 +119,16 @@ def _matching_ops(partner_fn, dtype):
         )
 
     def realized_degree_sum(t):
-        # Float like the synchronous branch: the downstream floats
-        # accounting multiplies by the payload and sums over chunks, which
-        # would overflow int32 at scale.
+        # float32 regardless of run dtype: the downstream floats accounting
+        # multiplies by the payload and sums over chunks, which overflows
+        # int32 at scale and quantizes above ~256 in bfloat16.
         p = partner_fn(t)
-        return jnp.sum((p != jnp.arange(p.shape[0])).astype(dtype))
+        return jnp.sum((p != jnp.arange(p.shape[0])).astype(jnp.float32))
 
     return mix, neighbor_sum, realized_degree_sum
 
 
-def make_round_robin_mixing(topo: Topology, dtype=jnp.float32) -> FaultyMixing:
+def make_round_robin_mixing(topo: Topology) -> FaultyMixing:
     """Deterministic matching schedule (``parallel/matchings.py`` phases) as
     time-varying mixing ops, same interface as ``make_faulty_mixing``."""
     from distributed_optimization_tpu.parallel.matchings import (
@@ -129,13 +138,13 @@ def make_round_robin_mixing(topo: Topology, dtype=jnp.float32) -> FaultyMixing:
     partners = jnp.asarray(round_robin_partners(topo), dtype=jnp.int32)
     n_phases, n = partners.shape
     mix, neighbor_sum, realized_degree_sum = _matching_ops(
-        lambda t: partners[t % n_phases], dtype
+        lambda t: partners[t % n_phases]
     )
     return FaultyMixing(
         mix=mix,
         neighbor_sum=neighbor_sum,
         realized_degree_sum=realized_degree_sum,
-        active=lambda t: jnp.ones(n, dtype=dtype),
+        active=lambda t: jnp.ones(n, dtype=jnp.float32),
         drop_prob=0.0,
         straggler_prob=0.0,
     )
@@ -159,28 +168,32 @@ def make_faulty_mixing(
     topo: Topology,
     drop_prob: float,
     seed: int,
-    dtype=jnp.float32,
     straggler_prob: float = 0.0,
     one_peer: bool = False,
 ) -> FaultyMixing:
-    """Build time-varying mixing operators for a base topology."""
+    """Build time-varying mixing operators for a base topology.
+
+    All internal fault machinery (masks, realized adjacency, MH weights,
+    degree accounting) runs in float32; only ``mix``/``neighbor_sum`` outputs
+    are cast back to the input's dtype.
+    """
     if not 0.0 <= drop_prob < 1.0:
         raise ValueError(f"drop_prob must be in [0, 1), got {drop_prob}")
     if not 0.0 <= straggler_prob < 1.0:
         raise ValueError(
             f"straggler_prob must be in [0, 1), got {straggler_prob}"
         )
-    base_A = jnp.asarray(topo.adjacency, dtype=dtype)
+    base_A = jnp.asarray(topo.adjacency, dtype=jnp.float32)
     # Distinct streams from batch sampling: fold tags into the seed key.
     fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
     node_key = jax.random.fold_in(jax.random.key(seed), 0x57A66)
 
     def active(t) -> jax.Array:
         if straggler_prob == 0.0:
-            return jnp.ones(base_A.shape[0], dtype=dtype)
+            return jnp.ones(base_A.shape[0], dtype=jnp.float32)
         key = jax.random.fold_in(node_key, t)
         u = jax.random.uniform(key, (base_A.shape[0],))
-        return (u >= straggler_prob).astype(dtype)
+        return (u >= straggler_prob).astype(jnp.float32)
 
     def realized_adjacency(t) -> jax.Array:
         if drop_prob == 0.0 and straggler_prob == 0.0:
@@ -199,16 +212,22 @@ def make_faulty_mixing(
         return sample_one_peer_matching(key, realized_adjacency(t))
 
     if one_peer:
-        mix, neighbor_sum, realized_degree_sum = _matching_ops(partner, dtype)
+        mix, neighbor_sum, realized_degree_sum = _matching_ops(partner)
     else:
+        # Accumulate in at-least-float32: bf16 inputs get the f32 upcast the
+        # accounting needs, while float64 fidelity runs keep full precision
+        # (the 0/1 adjacency is exact in any dtype, so casting it up first
+        # makes the MH weights exact in the accumulation dtype).
         def mix(t, x):
-            W = metropolis_hastings_weights(realized_adjacency(t))
-            return jnp.tensordot(W, x, axes=1).astype(x.dtype)
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            W = metropolis_hastings_weights(realized_adjacency(t).astype(acc))
+            return jnp.tensordot(W, x.astype(acc), axes=1).astype(x.dtype)
 
         def neighbor_sum(t, x):
-            return jnp.tensordot(realized_adjacency(t), x, axes=1).astype(
-                x.dtype
-            )
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            return jnp.tensordot(
+                realized_adjacency(t).astype(acc), x.astype(acc), axes=1
+            ).astype(x.dtype)
 
         def realized_degree_sum(t):
             return jnp.sum(realized_adjacency(t))

@@ -94,9 +94,27 @@ class RunCheckpointer:
             raise ValueError(
                 f"checkpoint directory {self.directory} was written by a "
                 f"different experiment (mismatched config fields: {diffs}); "
-                "point --checkpoint-dir elsewhere or pass resume=False "
-                "after clearing it"
+                "point --checkpoint-dir elsewhere, or pass resume=False "
+                "(--no-resume) to clear it and start fresh"
             )
+
+    def reset(self, config) -> None:
+        """Start the directory fresh for a ``resume=False`` run.
+
+        Clears every existing chunk checkpoint (a fresh run that leaves stale
+        higher-numbered chunks behind would poison a LATER resume) and
+        rewrites the config sidecar, so reusing a directory written by a
+        different experiment is allowed when the caller explicitly opted out
+        of resuming.
+        """
+        import contextlib
+        import shutil
+
+        for chunk in self.completed_chunks():
+            shutil.rmtree(self._step_dir(chunk), ignore_errors=True)
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(os.path.join(self.directory, self._CONFIG_SIDECAR))
+        self.validate_or_record_config(config)  # first-write path: records
 
     def completed_chunks(self) -> list[int]:
         out = []

@@ -137,3 +137,37 @@ def test_restore_empty_returns_none(tmp_path):
 def test_invalid_options():
     with pytest.raises(ValueError):
         CheckpointOptions("/tmp/x", every_evals=0)
+
+
+def test_no_resume_clears_stale_directory(data, tmp_path):
+    ds, f_opt = data
+    ckdir = str(tmp_path / "ck")
+    # Directory written by a DIFFERENT experiment, with chunks beyond the
+    # fresh run's horizon.
+    jax_backend.run(
+        CFG.replace(learning_rate_eta0=0.01), ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir, every_evals=2),
+    )
+    assert RunCheckpointer(CheckpointOptions(ckdir)).latest_chunk() == 10
+
+    # resume=False must start fresh instead of raising on the mismatched
+    # sidecar, and must clear the stale higher-numbered chunks that would
+    # otherwise poison a later resume.
+    short = CFG.replace(n_iterations=20)
+    jax_backend.run(
+        short, ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir, every_evals=5, resume=False),
+    )
+    ck = RunCheckpointer(CheckpointOptions(ckdir))
+    assert ck.completed_chunks() == [5]
+
+    # A later resume with the NEW config continues cleanly to the full run.
+    full = jax_backend.run(
+        CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir + "_ref")
+    )
+    resumed = jax_backend.run(
+        CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir, every_evals=5)
+    )
+    np.testing.assert_allclose(
+        resumed.final_models, full.final_models, rtol=1e-6, atol=1e-7
+    )

@@ -309,3 +309,36 @@ def test_invalid_drop_prob():
         ExperimentConfig(edge_drop_prob=1.0)
     with pytest.raises(ValueError):
         ExperimentConfig(edge_drop_prob=-0.1)
+
+
+def test_extra_rejects_faults():
+    # EXTRA carries the previous iteration's mix (W_{t-1} x_{t-1}); its
+    # exactness argument needs a static W, so time-varying gossip is refused.
+    ds = generate_synthetic_dataset(CFG)
+    with pytest.raises(ValueError, match="static W"):
+        jax_backend.run(CFG.replace(algorithm="extra", edge_drop_prob=0.1),
+                        ds, 0.0)
+    with pytest.raises(ValueError, match="static W"):
+        jax_backend.run(
+            CFG.replace(algorithm="extra", gossip_schedule="one_peer"),
+            ds, 0.0,
+        )
+
+
+def test_fault_accounting_is_float32_regardless_of_model_dtype():
+    # Degree sums above 256 quantize in bfloat16 (8 mantissa bits); the
+    # accounting must stay exact while mixed MODEL values keep the run dtype.
+    topo = build_topology("fully_connected", 40)  # degree sum 40*39 = 1560
+    fm = make_faulty_mixing(topo, 0.0, seed=2)
+    ds0 = fm.realized_degree_sum(jnp.asarray(0))
+    assert ds0.dtype == jnp.float32
+    assert float(ds0) == 40 * 39  # exactly; bf16 would round to 1552/1568
+
+    x16 = jnp.ones((40, 3), dtype=jnp.bfloat16)
+    assert fm.mix(jnp.asarray(0), x16).dtype == jnp.bfloat16
+    assert fm.neighbor_sum(jnp.asarray(0), x16).dtype == jnp.bfloat16
+    assert fm.active(jnp.asarray(0)).dtype == jnp.float32
+
+    one_peer = make_faulty_mixing(topo, 0.0, seed=2, one_peer=True)
+    assert one_peer.realized_degree_sum(jnp.asarray(1)).dtype == jnp.float32
+    assert one_peer.mix(jnp.asarray(1), x16).dtype == jnp.bfloat16
