@@ -97,18 +97,22 @@ def _make_eta_fn(config):
     return lambda t: jnp.asarray(eta0)
 
 
-def _run_checkpointed(
+def _run_chunked(
     chunk, state0, checkpoint, mesh, config, n_evals, measure_compile,
 ):
-    """Host-driven chunk loop with periodic orbax saves and resume.
+    """Host-driven chunk loop: measured per-eval timestamps, optional orbax
+    checkpointing (``checkpoint=None`` runs the loop purely for timing).
 
     One 'chunk' = ``eval_every`` fused iterations (the same compiled body the
     single-scan path uses); the host only intervenes at eval boundaries, so
     steady-state throughput matches the fused path up to one host sync per
-    ``eval_every`` iterations. Returns (final_state, gap_hist, cons_hist,
-    realized_floats, executed_iters, compile_seconds, run_seconds) —
-    ``executed_iters`` counts only iterations run in THIS process, so resumed
-    runs report honest throughput.
+    ``eval_every`` iterations. Each chunk records a real ``perf_counter``
+    timestamp — the measured wall-clock the reference samples per iteration
+    (trainer.py:63,181), at eval granularity. Returns (final_state, gap_hist,
+    cons_hist, time_hist, realized_floats, executed_iters, compile_seconds,
+    run_seconds) — ``executed_iters`` counts only iterations run in THIS
+    process, so resumed runs report honest throughput; ``time_hist`` is
+    cumulative across installments (restored timestamps carry an offset).
     """
     from distributed_optimization_tpu.parallel.mesh import (
         replicate as _replicate,
@@ -117,13 +121,15 @@ def _run_checkpointed(
     from distributed_optimization_tpu.utils.checkpoint import RunCheckpointer
 
     eval_every = config.eval_every
-    ckptr = RunCheckpointer(checkpoint)
-    if checkpoint.resume:
-        ckptr.validate_or_record_config(config)
-    else:
-        # Explicit fresh start: clear stale chunks (they would poison a later
-        # resume) and rewrite the sidecar instead of validating against it.
-        ckptr.reset(config)
+    ckptr = None
+    if checkpoint is not None:
+        ckptr = RunCheckpointer(checkpoint)
+        if checkpoint.resume:
+            ckptr.validate_or_record_config(config)
+        else:
+            # Explicit fresh start: clear stale chunks (they would poison a
+            # later resume) and rewrite the sidecar instead of validating.
+            ckptr.reset(config)
     ts_row0 = _replicate(mesh, jnp.arange(eval_every, dtype=jnp.int32))
 
     t0 = time.perf_counter()
@@ -135,11 +141,12 @@ def _run_checkpointed(
     gap_list: list[float] = []
     cons_list: list[float] = []
     floats_list: list[float] = []
+    time_list: list[float] = []
     start_chunk = 0
-    if checkpoint.resume:
+    if ckptr is not None and checkpoint.resume:
         restored = ckptr.restore()
         if restored is not None:
-            state_np, gaps, conss, floats, start_chunk = restored
+            state_np, gaps, conss, floats, times, start_chunk = restored
             if start_chunk > n_evals:
                 raise ValueError(
                     f"checkpoint at chunk {start_chunk} exceeds this run's "
@@ -150,7 +157,10 @@ def _run_checkpointed(
             gap_list = [float(v) for v in gaps]
             cons_list = [float(v) for v in conss]
             floats_list = [float(v) for v in floats]
+            time_list = [float(v) for v in times]
 
+    # Cumulative-time offset from previous installments of a resumed run.
+    time_offset = time_list[-1] if time_list else 0.0
     t1 = time.perf_counter()
     for c in range(start_chunk, n_evals):
         ts = _replicate(
@@ -164,20 +174,28 @@ def _run_checkpointed(
             cons_list.append(float(out["cons"]))
         if "floats" in out:
             floats_list.append(float(out["floats"]))
+        # The metric fetches above already forced the chunk to completion;
+        # sync explicitly anyway so the timestamp is honest when metrics
+        # collection is off.
+        jax.block_until_ready(state)
+        time_list.append(time_offset + time.perf_counter() - t1)
         done = c + 1
-        if done % checkpoint.every_evals == 0 or done == n_evals:
+        if ckptr is not None and (
+            done % checkpoint.every_evals == 0 or done == n_evals
+        ):
             ckptr.save(
-                done, _fetch_to_host(state), gap_list, cons_list, floats_list
+                done, _fetch_to_host(state),
+                gap_list, cons_list, floats_list, time_list,
             )
-    state = jax.block_until_ready(state)
     run_seconds = time.perf_counter() - t1
 
     gap_hist = np.asarray(gap_list, dtype=np.float64)
     cons_hist = np.asarray(cons_list, dtype=np.float64) if cons_list else None
+    time_hist = np.asarray(time_list, dtype=np.float64)
     realized_floats = float(np.sum(floats_list)) if floats_list else None
     executed_iters = (n_evals - start_chunk) * eval_every
-    return (state, gap_hist, cons_hist, realized_floats, executed_iters,
-            compile_seconds, run_seconds)
+    return (state, gap_hist, cons_hist, time_hist, realized_floats,
+            executed_iters, compile_seconds, run_seconds)
 
 
 def run(
@@ -191,8 +209,15 @@ def run(
     collect_metrics: bool = True,
     measure_compile: bool = True,
     checkpoint=None,
+    measure_timestamps: bool = False,
 ) -> BackendRunResult:
     """Run one experiment on the JAX backend; returns histories + final models.
+
+    ``measure_timestamps=True`` executes eval-chunks under a host-driven loop
+    recording a real ``perf_counter`` timestamp per eval (one host sync per
+    ``eval_every`` iterations) instead of the fully fused scan; the returned
+    history then carries measured wall-clock (``time_measured=True``) rather
+    than a linspace interpolation of the total run time.
 
     A float64 config runs under a scoped ``enable_x64`` — without it jax
     silently truncates every array to float32, defeating the fidelity dtype.
@@ -207,6 +232,7 @@ def run(
             config, dataset, f_opt, mesh=mesh, use_mesh=use_mesh,
             batch_schedule=batch_schedule, collect_metrics=collect_metrics,
             measure_compile=measure_compile, checkpoint=checkpoint,
+            measure_timestamps=measure_timestamps,
         )
 
 
@@ -221,6 +247,7 @@ def _run(
     collect_metrics: bool = True,
     measure_compile: bool = True,
     checkpoint=None,
+    measure_timestamps: bool = False,
 ) -> BackendRunResult:
     """Backend implementation (see ``run``).
 
@@ -451,7 +478,7 @@ def _run(
 
     n_evals = T // eval_every
 
-    if checkpoint is None:
+    if checkpoint is None and not measure_timestamps:
         def run_scan(state_init):
             ts = jnp.arange(T, dtype=jnp.int32).reshape(n_evals, eval_every)
             return jax.lax.scan(chunk, state_init, ts, unroll=outer_unroll)
@@ -480,11 +507,19 @@ def _run(
             float(np.sum(np.asarray(ys["floats"], dtype=np.float64)))
             if "floats" in ys else None
         )
+        # The fused scan runs on-device without per-eval host timestamps;
+        # spread the measured total uniformly (interpolated — the report
+        # labels it as such; pass measure_timestamps=True for real samples).
+        time_hist = np.linspace(
+            run_seconds / max(n_evals, 1), run_seconds, n_evals
+        )
+        time_measured = False
     else:
-        (final_state, gap_hist, cons_hist, realized_floats, executed_iters,
-         compile_seconds, run_seconds) = _run_checkpointed(
+        (final_state, gap_hist, cons_hist, time_hist, realized_floats,
+         executed_iters, compile_seconds, run_seconds) = _run_chunked(
             chunk, state0, checkpoint, mesh, config, n_evals, measure_compile,
         )
+        time_measured = True
         if not collect_metrics:
             gap_hist = np.full(n_evals, np.nan)
         if not track_consensus:
@@ -498,10 +533,8 @@ def _run(
     history = RunHistory(
         objective=gap_hist,
         consensus_error=cons_hist,
-        # The scan runs on-device without per-iter host timestamps; report the
-        # measured wall clock spread uniformly (documented deviation from the
-        # reference's per-iter time.time() samples, trainer.py:63,181).
-        time=np.linspace(run_seconds / max(n_evals, 1), run_seconds, n_evals),
+        time=time_hist,
+        time_measured=time_measured,
         eval_iterations=np.arange(eval_every, T + 1, eval_every),
         total_floats_transmitted=total_floats,
         # Throughput counts only iterations executed in THIS process, so a

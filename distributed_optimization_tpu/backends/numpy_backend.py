@@ -161,6 +161,7 @@ def run(
         objective=gap_hist,
         consensus_error=cons_hist if track_consensus else None,
         time=time_hist,
+        time_measured=True,  # real per-eval perf_counter samples
         eval_iterations=np.arange(eval_every, T + 1, eval_every),
         total_floats_transmitted=floats_per_iter * T,
         iters_per_second=T / run_seconds if run_seconds > 0 else float("inf"),

@@ -179,6 +179,11 @@ def build_parser() -> argparse.ArgumentParser:
                       help="start fresh even if DIR holds a checkpoint")
 
     diag = p.add_argument_group("profiling / diagnostics")
+    diag.add_argument("--measure-time", action="store_true",
+                      help="record real per-eval wall-clock timestamps "
+                           "(host-driven chunk loop; one sync per eval) "
+                           "instead of interpolating the fused scan's total "
+                           "(jax backend)")
     diag.add_argument("--profile-dir", metavar="DIR", default=None,
                       help="collect a jax.profiler (XProf/TensorBoard) trace "
                            "of the run into DIR")
@@ -313,6 +318,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             every_evals=args.checkpoint_every,
             resume=not args.no_resume,
         )
+    if args.measure_time:
+        if args.backend == "jax":
+            run_kwargs["measure_timestamps"] = True
+        elif args.backend == "cpp":
+            raise SystemExit(
+                "--measure-time is unsupported on the cpp backend (the "
+                "native core runs the whole horizon in one call); the numpy "
+                "backend always measures per-eval timestamps"
+            )
+        # numpy: already measured, flag is a no-op.
 
     if args.preflight:
         from distributed_optimization_tpu.utils.diagnostics import check_collectives
@@ -327,11 +342,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     sim = Simulator(config, dataset=dataset)
     with trace(args.profile_dir), nan_debugging(args.check_nans):
         if args.suite:
-            if run_kwargs:
+            if "checkpoint" in run_kwargs:
                 raise SystemExit(
                     "--checkpoint-dir applies to single runs, not --suite"
                 )
-            sim.run_all(verbose=not args.quiet)
+            sim.run_all(verbose=not args.quiet, run_kwargs=run_kwargs)
         else:
             sim.run_one(verbose=not args.quiet, run_kwargs=run_kwargs)
 

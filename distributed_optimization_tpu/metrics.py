@@ -41,11 +41,17 @@ class RunHistory:
     iters_per_second: float = float("nan")
     compile_seconds: float = 0.0  # AOT compile time (jax backend; 0 for numpy)
     spectral_gap: Optional[float] = None  # 1 − ρ of the run's mixing matrix
+    # True when ``time`` holds real per-eval perf_counter samples (the
+    # reference's trainer.py:63,181 measurement); False when it is a linspace
+    # interpolation of the total run wall-clock (fully fused scan) — the
+    # report marks derived sec→ε values accordingly.
+    time_measured: bool = False
 
     def as_dict(self) -> dict:
         out = {
             "objective": self.objective.tolist(),
             "time": self.time.tolist(),
+            "time_measured": self.time_measured,
         }
         if self.consensus_error is not None:
             out["consensus_error"] = self.consensus_error.tolist()
@@ -109,6 +115,7 @@ class NumericalResult:
     spectral_gap: Optional[float] = None
     iters_per_second: float = float("nan")
     seconds_to_threshold: float = float("nan")  # wall clock; nan = never
+    time_measured: bool = False  # sec→ε from real timestamps vs interpolation
 
 
 def summarize_run(
@@ -140,4 +147,5 @@ def summarize_run(
         spectral_gap=spectral_gap,
         iters_per_second=history.iters_per_second,
         seconds_to_threshold=seconds,
+        time_measured=history.time_measured,
     )

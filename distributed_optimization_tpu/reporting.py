@@ -38,13 +38,21 @@ def format_report(records, config, f_opt: float) -> str:
         f"{'floats/worker':>15}{'1−ρ':>8}{'iters/s':>10}"
     )
     lines += [header, "-" * len(header)]
+    any_interpolated = False
     for rec in records:
         if rec.skipped_reason is not None:
             lines.append(f"{rec.label:<28}{'N/A — ' + rec.skipped_reason}")
             continue
         s = rec.summary
         iters = str(s.iterations_to_threshold) if s.iterations_to_threshold > 0 else "never"
-        secs = f"{s.seconds_to_threshold:.2f}" if np.isfinite(s.seconds_to_threshold) else "—"
+        if np.isfinite(s.seconds_to_threshold):
+            # "~" = interpolated from the total run wall-clock, not a measured
+            # per-eval timestamp (fully fused scan path).
+            mark = "" if s.time_measured else "~"
+            any_interpolated |= not s.time_measured
+            secs = f"{mark}{s.seconds_to_threshold:.2f}"
+        else:
+            secs = "—"
         gap = f"{s.spectral_gap:.4f}" if s.spectral_gap is not None else "—"
         lines.append(
             f"{rec.label:<28}{iters:>9}{secs:>8}"
@@ -53,6 +61,11 @@ def format_report(records, config, f_opt: float) -> str:
             f"{s.iters_per_second:>10.1f}"
         )
     lines.append("=" * 78)
+    if any_interpolated:
+        lines.append(
+            "~ sec→ε interpolated from total run wall-clock "
+            "(use --measure-time for per-eval timestamps)"
+        )
     return "\n".join(lines)
 
 

@@ -134,7 +134,9 @@ class Simulator:
         self.records.append(record)
         return record
 
-    def run_all(self, *, verbose: bool = True) -> list[ExperimentRecord]:
+    def run_all(
+        self, *, verbose: bool = True, run_kwargs: Optional[dict] = None
+    ) -> list[ExperimentRecord]:
         """Run the reference's four-row experiment matrix.
 
         Grid is skipped with an N/A record when N is not a perfect square
@@ -149,18 +151,24 @@ class Simulator:
             overrides = {"algorithm": algorithm}
             if topology is not None:
                 overrides["topology"] = topology
-            self.run_one(label, verbose=verbose, **overrides)
+            self.run_one(
+                label, verbose=verbose, run_kwargs=run_kwargs, **overrides
+            )
         return self.records
 
     def run_suite(
-        self, specs: list[tuple[str, Optional[str]]], *, verbose: bool = True
+        self,
+        specs: list[tuple[str, Optional[str]]],
+        *,
+        verbose: bool = True,
+        run_kwargs: Optional[dict] = None,
     ) -> list[ExperimentRecord]:
         """Run an arbitrary list of (algorithm, topology-or-None) pairs."""
         for algorithm, topology in specs:
             overrides = {"algorithm": algorithm}
             if topology is not None:
                 overrides["topology"] = topology
-            self.run_one(verbose=verbose, **overrides)
+            self.run_one(verbose=verbose, run_kwargs=run_kwargs, **overrides)
         return self.records
 
     # -------------------------------------------------------------- reporting

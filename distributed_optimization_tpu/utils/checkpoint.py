@@ -128,7 +128,10 @@ class RunCheckpointer:
         chunks = self.completed_chunks()
         return chunks[-1] if chunks else None
 
-    def save(self, chunk: int, state: Any, gap_hist, cons_hist, floats_hist=()):
+    def save(
+        self, chunk: int, state: Any, gap_hist, cons_hist, floats_hist=(),
+        time_hist=(),
+    ):
         payload = {"state": state, "chunk": np.int64(chunk)}
         # Orbax rejects zero-size arrays; empty histories are simply omitted
         # and default to empty on restore.
@@ -136,6 +139,7 @@ class RunCheckpointer:
             ("gap_hist", gap_hist),
             ("cons_hist", cons_hist),
             ("floats_hist", floats_hist),
+            ("time_hist", time_hist),
         ):
             arr = np.asarray(hist, dtype=np.float64)
             if arr.size:
@@ -145,7 +149,8 @@ class RunCheckpointer:
         self._gc()
 
     def restore(self, chunk: Optional[int] = None):
-        """Return (state, gap_hist, cons_hist, floats_hist, chunk), or None."""
+        """Return (state, gap_hist, cons_hist, floats_hist, time_hist, chunk),
+        or None."""
         if chunk is None:
             chunk = self.latest_chunk()
         if chunk is None:
@@ -157,6 +162,7 @@ class RunCheckpointer:
             np.asarray(payload.get("gap_hist", empty)),
             np.asarray(payload.get("cons_hist", empty)),
             np.asarray(payload.get("floats_hist", empty)),
+            np.asarray(payload.get("time_hist", empty)),
             int(payload["chunk"]),
         )
 

@@ -1,0 +1,104 @@
+"""Measured wall-clock timestamps (VERDICT r1 item 4).
+
+The framework's own headline metric is wall-clock-to-threshold, so the
+``time`` history must be real where claimed: ``measure_timestamps=True``
+records one ``perf_counter`` sample per eval chunk (the reference measures
+per iteration, trainer.py:63,181); the fully fused scan keeps the linspace
+interpolation but is labeled as such in the report.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend, numpy_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.metrics import summarize_run
+from distributed_optimization_tpu.utils.checkpoint import CheckpointOptions
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+CFG = ExperimentConfig(
+    n_workers=8, n_samples=320, n_features=10, n_informative_features=6,
+    n_iterations=60, local_batch_size=8, problem_type="quadratic",
+    algorithm="dsgd", topology="ring", eval_every=6,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    return ds, f_opt
+
+
+def test_measured_timestamps_are_real_and_trajectory_matches_fused(data):
+    ds, f_opt = data
+    fused = jax_backend.run(CFG, ds, f_opt)
+    timed = jax_backend.run(CFG, ds, f_opt, measure_timestamps=True)
+
+    assert not fused.history.time_measured
+    assert timed.history.time_measured
+    t = timed.history.time
+    assert t.shape == (CFG.n_iterations // CFG.eval_every,)
+    assert np.all(t > 0)
+    assert np.all(np.diff(t) > 0)  # strictly increasing cumulative clock
+    # Same compiled chunk body -> same trajectory.
+    np.testing.assert_allclose(
+        timed.final_models, fused.final_models, rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        timed.history.objective, fused.history.objective, rtol=1e-5, atol=1e-7
+    )
+
+
+def test_numpy_backend_reports_measured_time(data):
+    ds, f_opt = data
+    res = numpy_backend.run(CFG.replace(backend="numpy"), ds, f_opt)
+    assert res.history.time_measured
+    assert np.all(np.diff(res.history.time) > 0)
+
+
+def test_resumed_run_carries_cumulative_time(data, tmp_path):
+    ds, f_opt = data
+    ckdir = str(tmp_path / "ck")
+    half = CFG.replace(n_iterations=30)
+    first = jax_backend.run(
+        half, ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir, every_evals=5, resume=False),
+    )
+    resumed = jax_backend.run(
+        CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir, every_evals=5)
+    )
+    t = resumed.history.time
+    assert resumed.history.time_measured
+    assert t.shape == (10,)
+    assert np.all(np.diff(t) > 0)
+    # The resumed installment's clock continues from the restored offset.
+    np.testing.assert_allclose(t[:5], first.history.time, rtol=1e-9)
+    assert t[5] > first.history.time[-1]
+
+
+def test_report_marks_interpolated_seconds(data):
+    from distributed_optimization_tpu.simulator import ExperimentRecord
+    from distributed_optimization_tpu.reporting import format_report
+
+    ds, f_opt = data
+    # A generous threshold guarantees sec→ε prints for both runs.
+    cfg = CFG.replace(suboptimality_threshold=1e6)
+    fused = jax_backend.run(cfg, ds, f_opt)
+    timed = jax_backend.run(cfg, ds, f_opt, measure_timestamps=True)
+    assert fused.history.objective[-1] <= cfg.suboptimality_threshold, (
+        "test premise: threshold must be crossed so the sec→ε column prints"
+    )
+
+    def record(label, res):
+        summary = summarize_run(
+            label, res.history, cfg.suboptimality_threshold, cfg.n_workers
+        )
+        return ExperimentRecord(label, cfg, res, summary)
+
+    text = format_report([record("fused", fused)], cfg, f_opt)
+    assert "~" in text and "interpolated" in text
+
+    text = format_report([record("timed", timed)], cfg, f_opt)
+    assert "interpolated" not in text
