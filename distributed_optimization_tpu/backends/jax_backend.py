@@ -236,6 +236,40 @@ def run(
         )
 
 
+def _resolve_auto_mixing_impl(config, topo, algo, mesh, platform: str) -> str:
+    """Resolve ``mixing_impl='auto'`` from measured data.
+
+    On a single real TPU chip the hand-fused pallas ring kernel (one VMEM pass
+    for W x − ηg) measured fastest end-to-end for the canonical D-SGD update —
+    5,080 vs 4,184 iters/sec for the XLA roll-stencil at N=256
+    (``docs/perf/mixing_bench.json``, produced by ``examples/bench_mixing.py``
+    on TPU v5e). Pick it exactly where that measurement applies: TPU, no
+    multi-device mesh (a pallas_call is an opaque custom call GSPMD cannot
+    partition), ring with the fused-step consumer (dsgd), static synchronous
+    topology (the fault machinery bypasses the mixing op anyway), float32.
+    Everything else keeps the round-1 rule: stencil where the graph embeds as
+    mesh shifts, dense for irregular graphs (``ops/mixing.py``).
+    """
+    if config.mixing_impl != "auto":
+        return config.mixing_impl
+    static_sync = (
+        config.edge_drop_prob == 0.0
+        and config.straggler_prob == 0.0
+        and config.gossip_schedule == "synchronous"
+    )
+    if (
+        platform == "tpu"
+        and mesh is None
+        and algo.name == "dsgd"
+        and topo.name == "ring"
+        and topo.n >= 3
+        and static_sync
+        and config.dtype == "float32"
+    ):
+        return "pallas"
+    return "auto"  # make_mixing_op resolves: stencil if supported, else dense
+
+
 def _run(
     config,
     dataset: HostDataset,
@@ -279,13 +313,16 @@ def _run(
                 mesh = make_worker_mesh(topo.grid_shape[0])
             else:
                 mesh = make_worker_mesh(n)
-        if config.mixing_impl == "shard_map":
+        mixing_impl = _resolve_auto_mixing_impl(
+            config, topo, algo, mesh, jax.devices()[0].platform
+        )
+        if mixing_impl == "shard_map":
             if mesh is None:
                 raise ValueError("shard_map mixing requires a device mesh")
             mix_op = make_shard_map_mixing_op(topo, mesh)
         else:
             mix_op = make_mixing_op(
-                topo, impl=config.mixing_impl, dtype=device_data.X.dtype
+                topo, impl=mixing_impl, dtype=device_data.X.dtype
             )
         degrees = jnp.asarray(topo.degrees, dtype=device_data.X.dtype)[:, None]
         # Per-edge payload: d · gossip_rounds for full-vector exchange, or the

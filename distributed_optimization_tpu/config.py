@@ -93,7 +93,11 @@ class ExperimentConfig:
     # cycles deterministic matchings that cover the edge set every P
     # iterations (ring/chain/even-sided grid).
     gossip_schedule: str = "synchronous"
-    mixing_impl: str = "auto"  # 'auto' | 'dense' | 'stencil' | 'shard_map'
+    # 'auto' | 'dense' | 'stencil' | 'shard_map' | 'pallas'. 'auto' picks the
+    # measured winner per platform (docs/perf/mixing_bench.json): the fused
+    # pallas kernel for single-chip-TPU dsgd/ring/f32, else stencil where the
+    # graph embeds as mesh shifts, else dense.
+    mixing_impl: str = "auto"
     # XLA scan unrolling for the jax backend's training loop. The per-worker
     # kernels here are tiny, so a single TPU chip is loop-dispatch-bound;
     # unrolling ~8 iterations per scan step roughly doubles steady-state

@@ -66,3 +66,50 @@ def test_stencil_rejected_for_irregular_graph():
 def test_auto_picks_stencil_for_regular_graphs():
     assert make_mixing_op(build_topology("ring", 8)).impl == "stencil"
     assert make_mixing_op(build_topology("erdos_renyi", 8, seed=0)).impl == "dense"
+
+
+def test_auto_impl_resolution_uses_measured_tpu_winner():
+    """auto -> pallas exactly where examples/bench_mixing.py measured the win:
+    single-chip TPU, dsgd on a static synchronous ring, float32."""
+    from distributed_optimization_tpu.algorithms import get_algorithm
+    from distributed_optimization_tpu.backends.jax_backend import (
+        _resolve_auto_mixing_impl,
+    )
+    from distributed_optimization_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig(algorithm="dsgd", topology="ring", n_workers=8)
+    topo = build_topology("ring", 8)
+    dsgd = get_algorithm("dsgd")
+
+    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "tpu") == "pallas"
+    # Outside the measured envelope: fall through to the stencil/dense rule.
+    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "cpu") == "auto"
+    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, object(), "tpu") == "auto"
+    assert (
+        _resolve_auto_mixing_impl(
+            cfg.replace(edge_drop_prob=0.1), topo, dsgd, None, "tpu"
+        )
+        == "auto"
+    )
+    assert (
+        _resolve_auto_mixing_impl(
+            cfg.replace(dtype="bfloat16"), topo, dsgd, None, "tpu"
+        )
+        == "auto"
+    )
+    gt = get_algorithm("gradient_tracking")
+    assert _resolve_auto_mixing_impl(cfg, topo, gt, None, "tpu") == "auto"
+    grid = build_topology("grid", 9)
+    assert (
+        _resolve_auto_mixing_impl(
+            cfg.replace(topology="grid", n_workers=9), grid, dsgd, None, "tpu"
+        )
+        == "auto"
+    )
+    # Explicit impls pass through untouched.
+    assert (
+        _resolve_auto_mixing_impl(
+            cfg.replace(mixing_impl="dense"), topo, dsgd, None, "tpu"
+        )
+        == "dense"
+    )
