@@ -91,13 +91,25 @@ def main() -> None:
 
     if not np.all(np.isfinite(hist.objective)):
         raise SystemExit("north-star run produced non-finite metrics")
-    # Convergence gate on the headline run itself (N=256 consensus is slow —
-    # spectral gap ~2e-5 — so full threshold convergence is not expected in
-    # 10k iters, but the gap must be shrinking and bounded).
-    if not (hist.objective[-1] < 1.0 and hist.objective[-1] < hist.objective[0]):
+    # Convergence gates on the headline run itself. The N=256 ring cannot
+    # reach 1e-4 consensus in 10k iters — its spectral gap (2.0e-4) puts the
+    # crossing at ~3e7 iterations, and at this horizon consensus is still in
+    # its transient GROWTH phase (~4e-3 → ~0.4, peaking before the ~1/t decay
+    # sets in; measured in docs/perf/scaling.json). The literal north-star
+    # crossing with measured wall-clock is demonstrated on the N=256 grid by
+    # examples/northstar_consensus.py → docs/perf/northstar_consensus.json.
+    # Here: the gap must halve (real optimization) and consensus must stay
+    # bounded (gossip contraction active, not diverging).
+    if not (hist.objective[-1] < 0.5 * hist.objective[0]):
         raise SystemExit(
             "north-star run is not optimizing — refusing to report "
             f"throughput (gap {hist.objective[0]:.4f} -> {hist.objective[-1]:.4f})"
+        )
+    cons = hist.consensus_error
+    if not (np.all(np.isfinite(cons)) and cons[-1] < 1.0):
+        raise SystemExit(
+            "north-star consensus error is unbounded — refusing to report "
+            f"throughput (consensus {cons[0]:.3e} -> {cons[-1]:.3e})"
         )
 
     print(
