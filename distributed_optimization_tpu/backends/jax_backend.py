@@ -53,20 +53,24 @@ from distributed_optimization_tpu.parallel.mesh import (
 from distributed_optimization_tpu.utils.data import HostDataset, stack_shards
 
 
-def make_full_objective_fn(problem, X, y, n_valid, reg):
+def make_full_objective_fn(problem, reg):
     """Full-dataset objective of a single model w, computed from the stacked
     per-worker shards (so it shards over the mesh and reduces with one psum).
 
     Equals the reference's objective over the concatenated dataset
     (trainer.py:67,189): padding rows carry zero weight and every real row
     weighs 1/total, so Σ_workers Σ_rows w_il·loss_il is the global mean.
-    """
-    L = X.shape[1]
-    mask = (jnp.arange(L)[None, :] < n_valid[:, None]).astype(X.dtype)
-    total = jnp.maximum(jnp.sum(n_valid).astype(X.dtype), 1.0)
-    weights = mask / total  # [N, L]
 
-    def full_objective(w):
+    X/y/n_valid are arguments (not captured) so the traced computation never
+    closes over globally-sharded arrays — closing over arrays that span
+    non-addressable devices is an error in multi-process runs.
+    """
+
+    def full_objective(w, X, y, n_valid):
+        L = X.shape[1]
+        mask = (jnp.arange(L)[None, :] < n_valid[:, None]).astype(X.dtype)
+        total = jnp.maximum(jnp.sum(n_valid).astype(X.dtype), 1.0)
+        weights = mask / total  # [N, L]
         per_worker = jax.vmap(
             lambda Xi, yi, wi: problem.objective_weighted(w, Xi, yi, wi, 0.0)
         )(X, y, weights)
@@ -98,10 +102,13 @@ def _make_eta_fn(config):
 
 
 def _run_chunked(
-    chunk, state0, checkpoint, mesh, config, n_evals, measure_compile,
+    chunk, state0, data_args, checkpoint, mesh, config, n_evals,
+    measure_compile,
 ):
     """Host-driven chunk loop: measured per-eval timestamps, optional orbax
     checkpointing (``checkpoint=None`` runs the loop purely for timing).
+    ``chunk(state, ts, data_args)`` takes the sharded data pytree as an
+    argument (multi-process safe; see ``make_chunk``).
 
     One 'chunk' = ``eval_every`` fused iterations (the same compiled body the
     single-scan path uses); the host only intervenes at eval boundaries, so
@@ -134,7 +141,7 @@ def _run_chunked(
 
     t0 = time.perf_counter()
     with jax.default_matmul_precision(config.matmul_precision):
-        compiled = jax.jit(chunk).lower(state0, ts_row0).compile()
+        compiled = jax.jit(chunk).lower(state0, ts_row0, data_args).compile()
     compile_seconds = time.perf_counter() - t0 if measure_compile else 0.0
 
     state = state0
@@ -167,7 +174,7 @@ def _run_chunked(
             mesh,
             jnp.arange(c * eval_every, (c + 1) * eval_every, dtype=jnp.int32),
         )
-        state, out = compiled(state, ts)
+        state, out = compiled(state, ts, data_args)
         if "gap" in out:
             gap_list.append(float(out["gap"]))
         if "cons" in out:
@@ -404,28 +411,17 @@ def _run(
     if batch_schedule is not None:
         schedule = replicate(mesh, jnp.asarray(batch_schedule, dtype=jnp.int32))
 
-    full_objective = make_full_objective_fn(problem, X, y, n_valid, reg)
+    full_objective = make_full_objective_fn(problem, reg)
     eta_fn = _make_eta_fn(config)
     batch_size = config.local_batch_size
 
-    def grad_fn_factory(t):
-        def grad(params, slot):
-            if schedule is not None:
-                idx = schedule[t]  # [N, b] injected batch indices
-                Xb = jnp.take_along_axis(X, idx[:, :, None], axis=1)
-                yb = jnp.take_along_axis(y, idx, axis=1)
-                wts = jnp.full(idx.shape, 1.0 / idx.shape[1], dtype=X.dtype)
-            else:
-                slot_key = jax.random.fold_in(key, slot)
-                Xb, yb, wts = sample_worker_batches(
-                    slot_key, t, X, y, n_valid, batch_size
-                )
-                wts = wts.astype(X.dtype)  # keep bf16 carries unpromoted
-            return jax.vmap(
-                problem.gradient_weighted, in_axes=(0, 0, 0, 0, None)
-            )(params, Xb, yb, wts, reg)
-
-        return grad
+    # Sharded arrays are threaded through jit as ARGUMENTS, never captured:
+    # a traced function that closes over an array spanning non-addressable
+    # devices raises in multi-process runs (caught by
+    # examples/multihost_smoke.py).
+    data_args = {"X": X, "y": y, "n_valid": n_valid}
+    if schedule is not None:
+        data_args["schedule"] = schedule
 
     track_consensus = (
         collect_metrics and algo.is_decentralized and config.record_consensus
@@ -455,80 +451,112 @@ def _run(
 
         fused_mix_step = fused_ring_dsgd_step
 
-    def step(state, t):
-        if faulty is not None:
-            mix_fn = lambda v: faulty.mix(t, v)  # noqa: E731
-            nbr_fn = lambda v: faulty.neighbor_sum(t, v)  # noqa: E731
-        elif mix_op is not None:
-            mix_fn, nbr_fn = mix_op.apply, mix_op.neighbor_sum
-        else:
-            mix_fn, nbr_fn = (lambda v: v), (lambda v: v * 0)
-        ctx = StepContext(
-            grad=grad_fn_factory(t),
-            mix=mix_fn,
-            neighbor_sum=nbr_fn,
-            # Cast to the run dtype so low-precision carries (bfloat16)
-            # aren't silently promoted by the f32 schedule scalar.
-            eta=eta_fn(t).astype(X.dtype),
-            t=t,
-            degrees=degrees,
-            config=config,
-            fused_mix_step=fused_mix_step,
-        )
-        new_state = algo.step(state, ctx)
-        if faulty is not None and faulty.straggler_prob > 0.0:
-            # A straggler takes no step at all: freeze its rows across every
-            # state leaf (each leaf leads with the worker axis). Its mixing
-            # row already degenerated to identity via the dropped edges.
-            m = faulty.active(t)
-            new_state = jax.tree.map(
-                lambda new, old: jnp.where(
-                    m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old
-                ),
-                new_state,
-                state,
-            )
-        return new_state, None
+    def make_chunk(data):
+        """Bind the step/chunk closures to the data pytree passed through jit."""
+        X, y, n_valid = data["X"], data["y"], data["n_valid"]
+        schedule = data.get("schedule")
 
-    def chunk(state, ts):
-        # ``eval_every`` iterations of pure optimization, then one on-device
-        # metric evaluation — the eval-cadence knob SURVEY.md §7 hard part (b)
-        # calls for (the reference evaluates every iteration; k=1 reproduces
-        # that exactly).
-        state, _ = jax.lax.scan(step, state, ts, unroll=inner_unroll)
-        out = {}
-        if collect_metrics:
-            x = state["x"]
-            xbar = jnp.mean(x, axis=0)
-            out["gap"] = full_objective(xbar) - f_opt
-            if track_consensus:
-                out["cons"] = jnp.mean(jnp.sum((x - xbar[None, :]) ** 2, axis=1))
-        if faulty is not None:
-            # Honest comms accounting under faults: floats actually exchanged
-            # over realized edges this chunk (recomputed from the fault keys,
-            # so it costs one tiny mask redraw per iteration, no extra
-            # communication).
-            out["floats"] = (
-                jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts)) * edge_payload
+        def grad_fn_factory(t):
+            def grad(params, slot):
+                if schedule is not None:
+                    idx = schedule[t]  # [N, b] injected batch indices
+                    Xb = jnp.take_along_axis(X, idx[:, :, None], axis=1)
+                    yb = jnp.take_along_axis(y, idx, axis=1)
+                    wts = jnp.full(idx.shape, 1.0 / idx.shape[1], dtype=X.dtype)
+                else:
+                    slot_key = jax.random.fold_in(key, slot)
+                    Xb, yb, wts = sample_worker_batches(
+                        slot_key, t, X, y, n_valid, batch_size
+                    )
+                    wts = wts.astype(X.dtype)  # keep bf16 carries unpromoted
+                return jax.vmap(
+                    problem.gradient_weighted, in_axes=(0, 0, 0, 0, None)
+                )(params, Xb, yb, wts, reg)
+
+            return grad
+
+        def step(state, t):
+            if faulty is not None:
+                mix_fn = lambda v: faulty.mix(t, v)  # noqa: E731
+                nbr_fn = lambda v: faulty.neighbor_sum(t, v)  # noqa: E731
+            elif mix_op is not None:
+                mix_fn, nbr_fn = mix_op.apply, mix_op.neighbor_sum
+            else:
+                mix_fn, nbr_fn = (lambda v: v), (lambda v: v * 0)
+            ctx = StepContext(
+                grad=grad_fn_factory(t),
+                mix=mix_fn,
+                neighbor_sum=nbr_fn,
+                # Cast to the run dtype so low-precision carries (bfloat16)
+                # aren't silently promoted by the f32 schedule scalar.
+                eta=eta_fn(t).astype(X.dtype),
+                t=t,
+                degrees=degrees,
+                config=config,
+                fused_mix_step=fused_mix_step,
             )
-        return state, out
+            new_state = algo.step(state, ctx)
+            if faulty is not None and faulty.straggler_prob > 0.0:
+                # A straggler takes no step at all: freeze its rows across
+                # every state leaf (each leaf leads with the worker axis). Its
+                # mixing row already degenerated to identity via the dropped
+                # edges.
+                m = faulty.active(t)
+                new_state = jax.tree.map(
+                    lambda new, old: jnp.where(
+                        m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old
+                    ),
+                    new_state,
+                    state,
+                )
+            return new_state, None
+
+        def chunk(state, ts):
+            # ``eval_every`` iterations of pure optimization, then one
+            # on-device metric evaluation — the eval-cadence knob SURVEY.md §7
+            # hard part (b) calls for (the reference evaluates every
+            # iteration; k=1 reproduces that exactly).
+            state, _ = jax.lax.scan(step, state, ts, unroll=inner_unroll)
+            out = {}
+            if collect_metrics:
+                x = state["x"]
+                xbar = jnp.mean(x, axis=0)
+                out["gap"] = full_objective(xbar, X, y, n_valid) - f_opt
+                if track_consensus:
+                    out["cons"] = jnp.mean(
+                        jnp.sum((x - xbar[None, :]) ** 2, axis=1)
+                    )
+            if faulty is not None:
+                # Honest comms accounting under faults: floats actually
+                # exchanged over realized edges this chunk (recomputed from
+                # the fault keys, so it costs one tiny mask redraw per
+                # iteration, no extra communication).
+                out["floats"] = (
+                    jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts))
+                    * edge_payload
+                )
+            return state, out
+
+        return chunk
 
     n_evals = T // eval_every
 
     if checkpoint is None and not measure_timestamps:
-        def run_scan(state_init):
+        def run_scan(state_init, data):
             ts = jnp.arange(T, dtype=jnp.int32).reshape(n_evals, eval_every)
-            return jax.lax.scan(chunk, state_init, ts, unroll=outer_unroll)
+            return jax.lax.scan(
+                make_chunk(data), state_init, ts, unroll=outer_unroll
+            )
 
         # AOT compile so compile time and steady-state execution are separable
         # (jax.profiler-style phase split, SURVEY.md §5.1).
         t0 = time.perf_counter()
         with jax.default_matmul_precision(config.matmul_precision):
-            compiled = jax.jit(run_scan).lower(state0).compile()
+            compiled = jax.jit(run_scan).lower(state0, data_args).compile()
         compile_seconds = time.perf_counter() - t0 if measure_compile else 0.0
 
         t1 = time.perf_counter()
-        final_state, ys = compiled(state0)
+        final_state, ys = compiled(state0, data_args)
         final_state = jax.block_until_ready(final_state)
         run_seconds = time.perf_counter() - t1
         executed_iters = T
@@ -552,9 +580,13 @@ def _run(
         )
         time_measured = False
     else:
+        def chunk_fn(state, ts, data):
+            return make_chunk(data)(state, ts)
+
         (final_state, gap_hist, cons_hist, time_hist, realized_floats,
          executed_iters, compile_seconds, run_seconds) = _run_chunked(
-            chunk, state0, checkpoint, mesh, config, n_evals, measure_compile,
+            chunk_fn, state0, data_args, checkpoint, mesh, config, n_evals,
+            measure_compile,
         )
         time_measured = True
         if not collect_metrics:
