@@ -11,10 +11,14 @@ gossip, full-dataset objective evaluated on the host every iteration — so it
    batches must produce matching trajectories — SURVEY.md §4c).
 
 Covers the two algorithms the reference implements (centralized SGD,
-D-SGD) via the same shared step rules the JAX backend uses; the extended
-algorithms (gradient tracking / EXTRA / ADMM) are JAX-backend capabilities
-(their step rules use jnp and have no reference counterpart to be an oracle
-for).
+D-SGD) via the same shared step rules the JAX backend uses, plus
+INDEPENDENT matrix-form host implementations of the exact first-order
+extensions (gradient tracking and EXTRA) written directly from their
+published recursions (Nedić-Olshevsky-Shi 2017 eq. DIGing; Shi-Ling-Wu-Yin
+2015 eq. 2.13) rather than through the shared ``Algorithm.step`` rules —
+so they serve as a long-horizon fixed-point oracle for the JAX backend
+(SURVEY.md §4c backend-equivalence strategy). ADMM/CHOCO remain
+JAX-backend-only capabilities.
 """
 
 from __future__ import annotations
@@ -37,7 +41,11 @@ from distributed_optimization_tpu.ops import losses_np
 from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.utils.data import HostDataset
 
-_SUPPORTED = ("centralized", "dsgd")
+_SUPPORTED = ("centralized", "dsgd", "gradient_tracking", "extra")
+
+# Algorithms with a dedicated matrix-form host implementation below,
+# independent of the shared ``Algorithm.step`` rules the JAX backend runs.
+_MATRIX_FORM = ("gradient_tracking", "extra")
 
 
 def run(
@@ -50,8 +58,9 @@ def run(
 ) -> BackendRunResult:
     if config.algorithm not in _SUPPORTED:
         raise ValueError(
-            f"numpy backend implements {_SUPPORTED} (the reference's algorithm "
-            f"set); {config.algorithm!r} is a jax-backend capability"
+            f"numpy backend implements {_SUPPORTED} (the reference's "
+            "algorithms plus matrix-form oracles for the exact first-order "
+            f"extensions); {config.algorithm!r} is a jax-backend capability"
         )
     if (
         config.edge_drop_prob > 0.0
@@ -115,11 +124,57 @@ def run(
 
         return grad
 
-    state = {k: np.asarray(v, dtype=np.float64) for k, v in
-             algo.init(
-                 np.zeros((n, d)), config,
-                 neighbor_sum=(lambda v: A @ v) if A is not None else None,
-             ).items()}
+    if config.algorithm in _MATRIX_FORM:
+        # Independent matrix recursions (NOT algo.init/algo.step): state
+        # leaves written out explicitly from the published update equations.
+        zeros = np.zeros((n, d))
+        if config.algorithm == "gradient_tracking":
+            # DIGing: x_{t+1} = W x_t − η y_t;  y_{t+1} = W y_t + g_{t+1} − g_t
+            # with y_0 = g_prev = 0 (first step is a pure gossip step).
+            state = {"x": zeros.copy(), "y": zeros.copy(), "g": zeros.copy()}
+
+            def matrix_step(state, t, eta, grad_at):
+                x_new = W @ state["x"] - eta * state["y"]
+                g_new = grad_at(x_new)
+                return {
+                    "x": x_new,
+                    "y": W @ state["y"] + g_new - state["g"],
+                    "g": g_new,
+                }
+
+        else:  # extra
+            # EXTRA (Shi et al. 2015):
+            #   x_1     = W x_0 − η g(x_0)
+            #   x_{t+1} = (I+W) x_t − (I+W)/2 x_{t−1} − η (g(x_t) − g(x_{t−1}))
+            # ``Wx_prev`` carries the previous iteration's W @ x, so each
+            # step performs exactly one dense mix (same comms accounting as
+            # the jax rule, which also reuses the carried mix).
+            state = {"x": zeros.copy(), "x_prev": zeros.copy(),
+                     "Wx_prev": zeros.copy(), "g": zeros.copy(),
+                     "started": False}
+
+            def matrix_step(state, t, eta, grad_at):
+                x = state["x"]
+                g = grad_at(x)
+                Wx = W @ x
+                if not state["started"]:
+                    x_new = Wx - eta * g
+                else:
+                    x_new = (
+                        x + Wx
+                        - 0.5 * (state["x_prev"] + state["Wx_prev"])
+                        - eta * (g - state["g"])
+                    )
+                return {"x": x_new, "x_prev": x, "Wx_prev": Wx, "g": g,
+                        "started": True}
+
+    else:
+        matrix_step = None
+        state = {k: np.asarray(v, dtype=np.float64) for k, v in
+                 algo.init(
+                     np.zeros((n, d)), config,
+                     neighbor_sum=(lambda v: A @ v) if A is not None else None,
+                 ).items()}
 
     eval_every = config.eval_every
     n_evals = T // eval_every
@@ -133,16 +188,20 @@ def run(
 
     for t in range(T):
         eta = eta0 / np.sqrt(t + 1.0) if sqrt_decay else eta0
-        ctx = StepContext(
-            grad=make_grad(t),
-            mix=(lambda v: W @ v) if W is not None else (lambda v: v),
-            neighbor_sum=(lambda v: A @ v) if A is not None else (lambda v: v * 0),
-            eta=eta,
-            t=t,
-            degrees=degrees,
-            config=config,
-        )
-        state = algo.step(state, ctx)
+        if matrix_step is not None:
+            grad_fn = make_grad(t)
+            state = matrix_step(state, t, eta, lambda p: grad_fn(p, 0))
+        else:
+            ctx = StepContext(
+                grad=make_grad(t),
+                mix=(lambda v: W @ v) if W is not None else (lambda v: v),
+                neighbor_sum=(lambda v: A @ v) if A is not None else (lambda v: v * 0),
+                eta=eta,
+                t=t,
+                degrees=degrees,
+                config=config,
+            )
+            state = algo.step(state, ctx)
         if (t + 1) % eval_every == 0:
             k = (t + 1) // eval_every - 1
             x = state["x"]

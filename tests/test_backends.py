@@ -4,52 +4,14 @@ matrix-form recursions, convergence oracles, comms accounting."""
 import numpy as np
 import pytest
 
+from conftest import batch_schedule as _schedule, small_backend_config as small_config
 from distributed_optimization_tpu.backends import run_algorithm
-from distributed_optimization_tpu.config import ExperimentConfig
 from distributed_optimization_tpu.ops import losses_np
 from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.utils import (
     compute_reference_optimum,
     generate_synthetic_dataset,
 )
-
-
-def small_config(**kw):
-    defaults = dict(
-        n_workers=8,
-        n_samples=400,
-        n_features=10,
-        n_informative_features=6,
-        problem_type="quadratic",
-        n_iterations=60,
-        topology="ring",
-        algorithm="dsgd",
-        backend="jax",
-        local_batch_size=16,
-    )
-    defaults.update(kw)
-    return ExperimentConfig(**defaults)
-
-
-@pytest.fixture(scope="module")
-def quad_setup():
-    cfg = small_config()
-    ds = generate_synthetic_dataset(cfg)
-    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
-    return cfg, ds, f_opt
-
-
-def _schedule(ds, T, batch, seed=0):
-    rng = np.random.default_rng(seed)
-    return np.stack(
-        [
-            [
-                rng.choice(len(ds.shard_indices[i]), batch, replace=False)
-                for i in range(len(ds.shard_indices))
-            ]
-            for _ in range(T)
-        ]
-    )
 
 
 @pytest.mark.parametrize("algorithm", ["centralized", "dsgd"])
@@ -255,7 +217,7 @@ def test_record_consensus_off(quad_setup):
 def test_numpy_backend_rejects_extended_algorithms(quad_setup):
     cfg, ds, f_opt = quad_setup
     with pytest.raises(ValueError, match="jax-backend capability"):
-        run_algorithm(cfg.replace(algorithm="extra", backend="numpy"), ds, f_opt)
+        run_algorithm(cfg.replace(algorithm="admm", backend="numpy"), ds, f_opt)
 
 
 def test_sqrt_decay_matches_reference_schedule(quad_setup):

@@ -1,0 +1,86 @@
+"""Long-horizon host oracle for the exact first-order extensions (VERDICT r1
+item 7): the numpy backend's INDEPENDENT matrix-form gradient-tracking and
+EXTRA implementations, checked (a) step-for-step against the JAX backend on
+injected batches, and (b) at a T>=2000 fixed point — constant step size,
+full-batch gradients — where GT/EXTRA must reach the sklearn optimum while
+plain D-SGD stalls at its non-IID bias floor (the study's core phenomenon,
+now verified by two implementations that share no step-rule code).
+"""
+
+import numpy as np
+import pytest
+
+from conftest import batch_schedule as _schedule
+from distributed_optimization_tpu.backends import run_algorithm
+
+
+@pytest.mark.parametrize("algorithm", ["gradient_tracking", "extra"])
+def test_matrix_form_oracle_matches_jax_on_injected_batches(quad_setup, algorithm):
+    """numpy matrix recursion ≡ jax step rule, step for step (T=40)."""
+    cfg, ds, f_opt = quad_setup
+    T = 40
+    sched = _schedule(ds, T, 8, seed=11)
+    kw = dict(algorithm=algorithm, n_iterations=T, learning_rate_eta0=0.01)
+    rj = run_algorithm(cfg.replace(**kw), ds, f_opt, batch_schedule=sched)
+    rn = run_algorithm(
+        cfg.replace(backend="numpy", **kw), ds, f_opt, batch_schedule=sched
+    )
+    np.testing.assert_allclose(rj.final_models, rn.final_models, rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(
+        rj.history.objective, rn.history.objective, rtol=2e-3, atol=5e-3
+    )
+    assert rj.total_floats_transmitted == rn.total_floats_transmitted
+
+
+@pytest.mark.parametrize("algorithm", ["gradient_tracking", "extra"])
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_long_horizon_fixed_point_vs_dsgd_stall(quad_setup, algorithm, backend):
+    """T=2000, constant step, full-batch gradients: the exact methods drive
+    suboptimality to the sklearn optimum (and consensus to ~machine level)
+    while D-SGD plateaus at a bias floor orders of magnitude higher.
+    batch=50 = the full shard, so the run is deterministic and the plateau is
+    the structural non-IID bias, not sampling noise."""
+    cfg, ds, f_opt = quad_setup
+    kw = dict(
+        n_iterations=2000,
+        local_batch_size=50,
+        lr_schedule="constant",
+        learning_rate_eta0=0.02,
+        backend=backend,
+        eval_every=100,
+        # The fixed-point check needs f64 on the jax path too: under float32
+        # EXTRA's difference recursion accumulates rounding and wanders at
+        # the ~1e-2 gap level instead of pinning the optimum.
+        dtype="float64",
+    )
+    exact = run_algorithm(cfg.replace(algorithm=algorithm, **kw), ds, f_opt)
+    dsgd = run_algorithm(cfg.replace(algorithm="dsgd", **kw), ds, f_opt)
+    # The saga oracle itself is only ~1e-7-accurate, so the exact methods can
+    # land marginally BELOW f_opt; compare in absolute value.
+    gap_exact = abs(exact.history.objective[-1])
+    gap_dsgd = dsgd.history.objective[-1]
+    assert gap_exact < 1e-5, f"{algorithm}/{backend} gap {gap_exact:.3e}"
+    assert gap_dsgd > 1e-3, f"dsgd unexpectedly exact: {gap_dsgd:.3e}"
+    assert gap_exact < 1e-2 * gap_dsgd
+    assert exact.history.consensus_error[-1] < 1e-8
+    # The fixed point is consensual: all workers agree on the optimum.
+    spread = np.abs(exact.final_models - exact.final_models.mean(0)).max()
+    assert spread < 1e-4
+
+
+def test_numpy_oracle_agrees_with_jax_at_long_horizon(quad_setup):
+    """Deterministic full-batch T=2000 runs: the two implementations land on
+    the same fixed point without sharing any step-rule code."""
+    cfg, ds, f_opt = quad_setup
+    kw = dict(
+        algorithm="extra",
+        n_iterations=2000,
+        local_batch_size=50,
+        lr_schedule="constant",
+        learning_rate_eta0=0.02,
+        eval_every=100,
+        dtype="float64",
+    )
+    rj = run_algorithm(cfg.replace(backend="jax", **kw), ds, f_opt)
+    rn = run_algorithm(cfg.replace(backend="numpy", **kw), ds, f_opt)
+    np.testing.assert_allclose(rj.final_models, rn.final_models, rtol=1e-4, atol=1e-5)
