@@ -340,6 +340,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from distributed_optimization_tpu.utils.profiling import trace
 
     sim = Simulator(config, dataset=dataset)
+    if not args.quiet:
+        # Generation-time per-worker distribution report (parity: reference
+        # utils.py:43-48) — makes the sorted-partition non-IID skew visible.
+        from distributed_optimization_tpu.utils.data import partition_summary
+
+        print(partition_summary(sim.dataset), file=sys.stderr)
     with trace(args.profile_dir), nan_debugging(args.check_nans):
         if args.suite:
             if "checkpoint" in run_kwargs:

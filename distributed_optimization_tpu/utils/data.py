@@ -147,6 +147,31 @@ def generate_digits_dataset(config) -> HostDataset:
     )
 
 
+def partition_summary(dataset: HostDataset) -> str:
+    """Per-worker shard report, parity with the reference's generation-time
+    printout (reference ``utils.py:43-48``): shard size, target range, and
+    mean per worker — the lines that make the sorted-partition non-IID skew
+    visible — plus the dataset totals line.
+    """
+    lines = []
+    for i in range(dataset.n_workers):
+        _, yi = dataset.shard(i)
+        if len(yi) == 0:
+            # n_workers > n_samples leaves trailing shards empty (array_split
+            # semantics); runnable downstream, so report rather than crash.
+            lines.append(f"Worker {i}: 0 samples")
+            continue
+        lines.append(
+            f"Worker {i}: {len(yi)} samples, Target y range: "
+            f"[{yi.min():.2f}, {yi.max():.2f}], Mean y: {yi.mean():.2f}"
+        )
+    lines.append(
+        f"Generated {dataset.X_full.shape[0]} samples, "
+        f"{dataset.n_features} features"
+    )
+    return "\n".join(lines)
+
+
 def stack_shards(dataset: HostDataset, dtype=np.float32) -> DeviceDataset:
     """Stack ragged shards into padded [N, L, d] arrays for the device path."""
     n = dataset.n_workers
