@@ -98,12 +98,12 @@ class ExperimentConfig:
     # pallas kernel for single-chip-TPU dsgd/ring/f32, else stencil where the
     # graph embeds as mesh shifts, else dense.
     mixing_impl: str = "auto"
-    # XLA scan unrolling for the jax backend's training loop. The per-worker
-    # kernels here are tiny, so a single TPU chip is loop-dispatch-bound;
-    # unrolling ~8 iterations per scan step roughly doubles steady-state
-    # throughput (measured) at a compile-time cost. 0 = auto: 8 on
-    # accelerators, 1 on CPU (where the compile cost dwarfs the tiny kernels'
-    # dispatch savings).
+    # XLA scan unrolling for the jax backend's training loop. Swept on the
+    # real chip (examples/bench_breakdown.py → docs/perf/breakdown.json):
+    # 1/2/4/8 measure within noise of each other, 16+ regress and cost more
+    # compile time. 0 = auto: 8 on accelerators (within noise of best,
+    # +0.9s compile vs unroll=1), 1 on CPU (where compile cost dwarfs the
+    # tiny kernels' dispatch savings).
     scan_unroll: int = 0
     dtype: str = "float32"
     matmul_precision: str = "highest"  # jax.lax Precision for parity-sensitive math

@@ -1,0 +1,163 @@
+"""Where the time goes at the N=256 headline config (VERDICT r1 item 5).
+
+Three measurements on the real chip, one JSON artifact
+(``docs/perf/breakdown.json``) + a summary table in ``docs/PERF.md``:
+
+1. **Component attribution.** The headline step has three cost centers —
+   per-worker minibatch gradients, the gossip mix, and the every-eval
+   full-dataset objective. Measure throughput of the full config, then with
+   metrics off (no full-dataset eval), then centralized (no gossip, same
+   gradient work), then with eval_every=100 (eval amortized 100x). The deltas
+   attribute steady-state time to each component without needing an XProf GUI
+   (the raw trace is also captured to ``docs/perf/trace/`` when
+   ``--trace`` is passed).
+
+2. **eval_every sensitivity.** The reference evaluates the full-dataset
+   objective EVERY iteration (reference ``trainer.py:67,189``) — parity mode
+   k=1. Sweep k ∈ {1, 10, 100} + metrics-off to show what the parity
+   constraint costs and what a production cadence buys.
+
+3. **scan_unroll sweep.** ``config.scan_unroll`` defaults to 8 on
+   accelerators; round 1 justified it with an unrecorded measurement. Sweep
+   {1, 2, 4, 8, 16, 32} and record throughput + compile time so the default
+   is evidence, not folklore.
+
+Every row is best-of-2 of an identical workload (shared-tunnel chip noise).
+Usage: ``python examples/bench_breakdown.py [--trace]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_optimization_tpu.backends import jax_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+T = 10_000
+BASE = dict(
+    problem_type="logistic", algorithm="dsgd", topology="ring",
+    n_workers=256, n_iterations=T,
+)
+
+
+def measure(cfg, ds, f_opt, repeats=2, **kw):
+    best = 0.0
+    compile_s = 0.0
+    for _ in range(repeats):
+        res = jax_backend.run(cfg, ds, f_opt, **kw)
+        best = max(best, float(res.history.iters_per_second))
+        compile_s = float(res.history.compile_seconds)
+    return best, compile_s
+
+
+def measure_group(variants, ds, f_opt, cycles=3):
+    """Round-robin measurement of several variants: every cycle runs each
+    variant once, best-of-cycles per variant. Interleaving means co-tenant
+    load swings hit all variants roughly equally, so the DELTAS between rows
+    are meaningful — sequential best-of-2 per row was dominated by chip noise
+    between rows.
+    """
+    best = {name: 0.0 for name in variants}
+    for _ in range(cycles):
+        for name, (cfg, kw) in variants.items():
+            res = jax_backend.run(cfg, ds, f_opt, **kw)
+            best[name] = max(best[name], float(res.history.iters_per_second))
+    return best
+
+
+def main() -> None:
+    trace = "--trace" in sys.argv
+    root = pathlib.Path(__file__).resolve().parents[1]
+    out_dir = root / "docs" / "perf"
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    cfg = ExperimentConfig(**BASE)
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    results: dict = {"config": "dsgd ring logistic N=256 T=10k", "device": str(
+        jax_backend.jax.devices()[0])}
+
+    # --- 1. component attribution (round-robin interleaved) ---
+    cent = cfg.replace(algorithm="centralized", topology="fully_connected")
+    rows = measure_group(
+        {
+            "full (parity k=1)": (cfg, {}),
+            "metrics off (no full-data eval)": (
+                cfg, {"collect_metrics": False}
+            ),
+            "centralized (no gossip)": (cent, {"collect_metrics": False}),
+        },
+        ds, f_opt,
+    )
+    results["attribution_iters_per_sec"] = {
+        k: round(v, 1) for k, v in rows.items()
+    }
+    ips_full = rows["full (parity k=1)"]
+    ips_noeval = rows["metrics off (no full-data eval)"]
+    ips_nogossip = rows["centralized (no gossip)"]
+    us = lambda ips: 1e6 / ips  # noqa: E731
+    results["attribution_us_per_iter"] = {
+        "total (k=1)": round(us(ips_full), 2),
+        "full-data eval": round(us(ips_full) - us(ips_noeval), 2),
+        "gossip (mix+consensus-free delta)": round(
+            us(ips_noeval) - us(ips_nogossip), 2
+        ),
+        "gradients+step+dispatch": round(us(ips_nogossip), 2),
+    }
+    print(f"[breakdown] attribution: {results['attribution_us_per_iter']}",
+          file=sys.stderr)
+
+    # --- 2. eval_every sensitivity (round-robin interleaved) ---
+    sweep_rows = measure_group(
+        {str(k): (cfg.replace(eval_every=k), {}) for k in (1, 10, 100)},
+        ds, f_opt,
+    )
+    sweep = {k: round(v, 1) for k, v in sweep_rows.items()}
+    sweep["inf (metrics off)"] = round(ips_noeval, 1)
+    results["eval_every_iters_per_sec"] = sweep
+    print(f"[breakdown] eval_every: {sweep}", file=sys.stderr)
+
+    # --- 3. scan_unroll sweep (at the parity cadence k=1, interleaved) ---
+    compile_secs = {}
+    unroll_cfgs = {}
+    for u in (1, 2, 4, 8, 16, 32):
+        ucfg = cfg.replace(scan_unroll=u)
+        _, comp = measure(ucfg, ds, f_opt, repeats=1)  # record compile cost
+        compile_secs[str(u)] = comp
+        unroll_cfgs[str(u)] = (ucfg, {})
+    unroll_ips = measure_group(unroll_cfgs, ds, f_opt, cycles=2)
+    unroll = {
+        u: {"iters_per_sec": round(unroll_ips[u], 1),
+            "compile_seconds": round(compile_secs[u], 1)}
+        for u in unroll_cfgs
+    }
+    results["scan_unroll"] = unroll
+    print(f"[breakdown] scan_unroll: {unroll}", file=sys.stderr)
+
+    if trace:
+        import jax
+
+        trace_dir = out_dir / "trace"
+        with jax.profiler.trace(str(trace_dir)):
+            jax_backend.run(
+                cfg.replace(n_iterations=1000), ds, f_opt,
+                measure_compile=False,
+            )
+        results["trace_dir"] = str(trace_dir.relative_to(root))
+        print(f"[breakdown] trace written to {trace_dir}", file=sys.stderr)
+
+    path = out_dir / "breakdown.json"
+    path.write_text(json.dumps(results, indent=2) + "\n")
+    print(json.dumps({"wrote": str(path.relative_to(root))}))
+
+
+if __name__ == "__main__":
+    main()
