@@ -38,7 +38,10 @@ from distributed_optimization_tpu.metrics import (
 )
 from distributed_optimization_tpu.models import get_problem
 from distributed_optimization_tpu.ops.mixing import make_mixing_op
-from distributed_optimization_tpu.ops.sampling import sample_worker_batches
+from distributed_optimization_tpu.ops.sampling import (
+    sample_worker_batch_weights,
+    sample_worker_batches,
+)
 from distributed_optimization_tpu.parallel.faults import (
     make_faulty_mixing,
     make_round_robin_mixing,
@@ -414,6 +417,9 @@ def _run(
     full_objective = make_full_objective_fn(problem, reg)
     eta_fn = _make_eta_fn(config)
     batch_size = config.local_batch_size
+    sampling_impl = config.resolved_sampling_impl(
+        jax.devices()[0].platform, device_data.X.shape[1]
+    )
 
     # Sharded arrays are threaded through jit as ARGUMENTS, never captured:
     # a traced function that closes over an array spanning non-addressable
@@ -463,6 +469,16 @@ def _run(
                     Xb = jnp.take_along_axis(X, idx[:, :, None], axis=1)
                     yb = jnp.take_along_axis(y, idx, axis=1)
                     wts = jnp.full(idx.shape, 1.0 / idx.shape[1], dtype=X.dtype)
+                elif sampling_impl == "dense":
+                    # Dense-weights sampling: no top_k, no gather — the
+                    # weighted gradient runs over the full padded shard with
+                    # 1/b weights on the sampled rows (same subsets as the
+                    # gather path for the same key; see ops/sampling.py).
+                    slot_key = jax.random.fold_in(key, slot)
+                    Xb, yb = X, y
+                    wts = sample_worker_batch_weights(
+                        slot_key, t, n_valid, X.shape[1], batch_size
+                    ).astype(X.dtype)
                 else:
                     slot_key = jax.random.fold_in(key, slot)
                     Xb, yb, wts = sample_worker_batches(

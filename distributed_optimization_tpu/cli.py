@@ -157,6 +157,14 @@ def build_parser() -> argparse.ArgumentParser:
                        choices=("auto", "dense", "stencil", "shard_map",
                                 "pallas"),
                        default=_DEFAULTS.mixing_impl)
+    execg.add_argument("--sampling-impl",
+                       choices=("auto", "gather", "dense"),
+                       default=_DEFAULTS.sampling_impl,
+                       help="mini-batch realization on the jax backend: "
+                            "gathered [N,b,d] batches vs dense per-row "
+                            "weights over the full shard (auto = measured "
+                            "rule: dense for shards <= 64 rows on "
+                            "accelerators)")
     execg.add_argument("--scan-unroll", type=int, default=_DEFAULTS.scan_unroll,
                        help="XLA unroll factor for the training scan "
                             "(0 = auto: 8 on accelerators, 1 on CPU)")
@@ -233,6 +241,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         straggler_prob=args.straggler_prob,
         gossip_schedule=args.gossip_schedule,
         mixing_impl=args.mixing_impl,
+        sampling_impl=args.sampling_impl,
         scan_unroll=args.scan_unroll,
         dtype=args.dtype,
         matmul_precision=args.matmul_precision,

@@ -98,6 +98,12 @@ class ExperimentConfig:
     # pallas kernel for single-chip-TPU dsgd/ring/f32, else stencil where the
     # graph embeds as mesh shifts, else dense.
     mixing_impl: str = "auto"
+    # 'auto' | 'gather' | 'dense'. Mini-batch realization on the jax backend:
+    # 'gather' materializes [N, b, d] batches (top_k + row gathers), 'dense'
+    # computes the weighted gradient over the full padded shard with 1/b
+    # weights on the sampled rows — same sampled subsets, no top_k/gather.
+    # 'auto' picks from measurement (see resolved_sampling_impl).
+    sampling_impl: str = "auto"
     # XLA scan unrolling for the jax backend's training loop. Swept on the
     # real chip (examples/bench_breakdown.py → docs/perf/breakdown.json):
     # 1/2/4/8 measure within noise of each other, 16+ regress and cost more
@@ -121,6 +127,8 @@ class ExperimentConfig:
         if self.mixing_impl not in ("auto", "dense", "stencil", "shard_map",
                                     "pallas"):
             raise ValueError(f"Unknown mixing impl: {self.mixing_impl}")
+        if self.sampling_impl not in ("auto", "gather", "dense"):
+            raise ValueError(f"Unknown sampling impl: {self.sampling_impl}")
         if self.lr_schedule not in ("auto", "sqrt_decay", "constant"):
             raise ValueError(f"Unknown lr schedule: {self.lr_schedule}")
         if self.compression not in COMPRESSIONS:
@@ -187,6 +195,25 @@ class ExperimentConfig:
                 raise ValueError(
                     f"grid topology requires a perfect-square worker count, got {self.n_workers}"
                 )
+
+    def resolved_sampling_impl(self, platform: str, n_local: int) -> str:
+        """Resolve sampling_impl='auto' from measured data.
+
+        On the real chip (docs/perf/breakdown.json §sampling) the dense
+        weighted-gradient form wins decisively when shards are small — the
+        latency-bound regime where top_k+gather dominate the iteration:
+        2.5x at N=256 (L=49), 10x at N=1024 (L=13) — while the gather path
+        wins for large shards (N=25, L=500: 1.8x) where the full-shard pass
+        costs real FLOPs; the two tie within chip noise for L ~ 100-250.
+        Rule: dense on accelerators when the padded shard length is <= 64
+        rows; gather otherwise (and always on CPU, where the extra FLOPs are
+        not latency-hidden).
+        """
+        if self.sampling_impl != "auto":
+            return self.sampling_impl
+        if platform != "cpu" and n_local <= 64:
+            return "dense"
+        return "gather"
 
     def resolved_scan_unroll(self, platform: str) -> int:
         if self.scan_unroll > 0:

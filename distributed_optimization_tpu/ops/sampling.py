@@ -25,6 +25,34 @@ import jax
 import jax.numpy as jnp
 
 
+
+def _worker_keys(key: jax.Array, step: jax.Array, n_workers: int) -> jax.Array:
+    """Per-(iteration, worker) keys: fold_in(fold_in(key, step), worker_id).
+
+    SHARED by the gather and dense sampling paths — both must derive the
+    identical key stream or their sampled subsets diverge (the dense==gather
+    equivalence is structural, not just tested).
+    """
+    step_key = jax.random.fold_in(key, step)
+    return jax.vmap(lambda i: jax.random.fold_in(step_key, i))(
+        jnp.arange(n_workers)
+    )
+
+
+def _masked_scores(worker_key: jax.Array, n_local: int, n_valid: jax.Array) -> jax.Array:
+    """One worker's uniform ranking scores with padding rows pushed to -inf.
+    Shared by both sampling paths (same draw => same subset)."""
+    scores = jax.random.uniform(worker_key, (n_local,))
+    valid = jnp.arange(n_local) < n_valid
+    return jnp.where(valid, scores, -jnp.inf)
+
+
+def _effective_batch(batch_size: int, n_valid: jax.Array, n_local: int) -> jax.Array:
+    """min(batch_size, n_valid, n_local) — the reference's batch clamp
+    (worker.py:21), shared by both sampling paths."""
+    return jnp.minimum(jnp.minimum(batch_size, n_valid), n_local)
+
+
 def sample_batch_indices(
     key: jax.Array, n_local: int, n_valid: jax.Array, batch_size: int
 ) -> tuple[jax.Array, jax.Array]:
@@ -35,20 +63,60 @@ def sample_batch_indices(
     draws and 0 on padding rows. Uses the Gumbel-top-k trick (uniform scores +
     top-k) so shapes stay static under jit.
     """
-    scores = jax.random.uniform(key, (n_local,))
-    # Push invalid (padding) rows to the bottom of the ranking.
-    valid = jnp.arange(n_local) < n_valid
-    scores = jnp.where(valid, scores, -jnp.inf)
-    # A shard can be smaller than the requested batch (reference worker.py:21
-    # clamps the effective batch); keep static shapes by tiling the top-k
-    # indices up to batch_size and zero-weighting the surplus rows.
+    scores = _masked_scores(key, n_local, n_valid)
+    # A shard can be smaller than the requested batch; keep static shapes by
+    # tiling the top-k indices up to batch_size and zero-weighting the
+    # surplus rows.
     k = min(batch_size, n_local)
     _, top_indices = jax.lax.top_k(scores, k)
     indices = jnp.resize(top_indices, (batch_size,))
-    effective = jnp.minimum(jnp.minimum(batch_size, n_valid), n_local)
+    effective = _effective_batch(batch_size, n_valid, n_local)
     draw_is_real = jnp.arange(batch_size) < effective
     weights = jnp.where(draw_is_real, 1.0 / jnp.maximum(effective, 1), 0.0)
     return indices.astype(jnp.int32), weights.astype(jnp.float32)
+
+
+def sample_worker_batch_weights(
+    key: jax.Array,
+    step: jax.Array,
+    n_valid: jax.Array,  # [N] true shard sizes
+    n_local: int,  # L, the padded shard length
+    batch_size: int,
+) -> jax.Array:
+    """Dense-weights formulation of per-worker batch sampling: ``[N, L]``
+    weights carrying ``1/b_eff`` on sampled rows and 0 elsewhere.
+
+    Selects the SAME row subsets as :func:`sample_worker_batches` for the
+    same key (same per-worker uniform draw; membership in the top
+    ``b_eff`` scores computed by rank instead of ``lax.top_k``, with ties
+    broken toward the lower index exactly like a stable top-k — ties have
+    ~zero probability for float32 uniforms anyway). The gradient over the
+    full shard with these weights equals the gathered mini-batch gradient.
+
+    Why it exists: the gather path runs batched ``top_k`` + row gathers
+    every iteration — serial latency-bound ops on TPU. This form trades
+    them for one [L, L] comparison matrix and a full-shard weighted
+    gradient: ~L/b more FLOPs, but fewer/larger ops, which wins when the
+    step is latency-bound (measured: docs/perf/breakdown.json — the
+    full-shard objective pass costs ~4µs while the sampling+gather
+    machinery dominates the 84µs iteration).
+    """
+    worker_keys = _worker_keys(key, step, n_valid.shape[0])
+    idx = jnp.arange(n_local)
+
+    def one(worker_key, ni):
+        u = _masked_scores(worker_key, n_local, ni)
+        # rank[l] = #{m : u_m > u_l, or u_m == u_l with m < l} — the position
+        # l would take in a stable descending sort (= lax.top_k order).
+        beats = (u[None, :] > u[:, None]) | (
+            (u[None, :] == u[:, None]) & (idx[None, :] < idx[:, None])
+        )
+        rank = jnp.sum(beats, axis=1)
+        effective = _effective_batch(batch_size, ni, n_local)
+        sel = (rank < effective) & (idx < ni)
+        return jnp.where(sel, 1.0 / jnp.maximum(effective, 1), 0.0)
+
+    return jax.vmap(one)(worker_keys, n_valid).astype(jnp.float32)
 
 
 def sample_worker_batches(
@@ -65,11 +133,7 @@ def sample_worker_batches(
     ``fold_in(fold_in(key, step), worker_id)`` — independent of every other
     worker and iteration.
     """
-    n_workers = X.shape[0]
-    step_key = jax.random.fold_in(key, step)
-    worker_keys = jax.vmap(lambda i: jax.random.fold_in(step_key, i))(
-        jnp.arange(n_workers)
-    )
+    worker_keys = _worker_keys(key, step, X.shape[0])
 
     def one(worker_key, Xi, yi, ni):
         idx, w = sample_batch_indices(worker_key, Xi.shape[0], ni, batch_size)

@@ -142,6 +142,29 @@ def main() -> None:
     results["scan_unroll"] = unroll
     print(f"[breakdown] scan_unroll: {unroll}", file=sys.stderr)
 
+    # --- 4. sampling_impl: gather vs dense weighted-gradient form ---
+    # (the measurement behind config.resolved_sampling_impl's auto rule)
+    samp = {}
+    for n in (25, 256, 1024):
+        ncfg = ExperimentConfig(**{**BASE, "n_workers": n,
+                                   "n_iterations": 4000})
+        if n == cfg.n_workers:
+            # Same data as the main config (generation depends only on the
+            # problem/sample knobs + N) — skip the redundant oracle solve.
+            nds, nf = ds, f_opt
+        else:
+            nds = generate_synthetic_dataset(ncfg)
+            _, nf = compute_reference_optimum(nds, ncfg.reg_param)
+        L = max(len(i) for i in nds.shard_indices)
+        res = measure_group(
+            {impl: (ncfg.replace(sampling_impl=impl), {})
+             for impl in ("gather", "dense")},
+            nds, nf, cycles=2,
+        )
+        samp[f"N={n} (L={L})"] = {k: round(v, 1) for k, v in res.items()}
+    results["sampling_impl_iters_per_sec"] = samp
+    print(f"[breakdown] sampling: {samp}", file=sys.stderr)
+
     if trace:
         import jax
 

@@ -75,3 +75,72 @@ def test_sampling_is_jittable():
     y = jnp.ones((3, 10))
     out = f(jax.random.key(0), jnp.asarray(5), X, y, jnp.full((3,), 10))
     assert out[0].shape == (3, 4, 2)
+
+
+def test_dense_weight_sampling_selects_same_subsets_as_gather():
+    """sample_worker_batch_weights must pick the SAME rows as the gather path
+    (same key => same uniforms => same top-b subset), expressed as weights."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_optimization_tpu.ops.sampling import (
+        sample_batch_indices,
+        sample_worker_batch_weights,
+    )
+
+    key = jax.random.key(7)
+    n_local, batch = 13, 5
+    n_valid = jnp.array([13, 9, 3, 0, 1])
+    step = 4
+    w_dense = sample_worker_batch_weights(key, step, n_valid, n_local, batch)
+    # Rebuild the gather path's per-worker keys the same way.
+    step_key = jax.random.fold_in(key, step)
+    for i in range(len(n_valid)):
+        wk = jax.random.fold_in(step_key, i)
+        idx, w = sample_batch_indices(wk, n_local, n_valid[i], batch)
+        dense_rows = np.nonzero(np.asarray(w_dense[i]) > 0)[0]
+        gather_rows = np.unique(np.asarray(idx)[np.asarray(w) > 0])
+        np.testing.assert_array_equal(np.sort(dense_rows), gather_rows)
+        eff = min(batch, int(n_valid[i]))
+        if eff:
+            np.testing.assert_allclose(
+                np.asarray(w_dense[i])[dense_rows], 1.0 / eff, rtol=1e-6
+            )
+        else:
+            assert dense_rows.size == 0
+
+
+def test_dense_sampling_backend_trajectory_matches_gather():
+    """Full backend runs with sampling_impl gather vs dense produce identical
+    trajectories (same sampled subsets, same math, fp-tolerance)."""
+    import numpy as np
+
+    from conftest import small_backend_config
+    from distributed_optimization_tpu.backends import run_algorithm
+    from distributed_optimization_tpu.utils import (
+        compute_reference_optimum,
+        generate_synthetic_dataset,
+    )
+
+    cfg = small_backend_config(n_iterations=40)
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    rg = run_algorithm(cfg.replace(sampling_impl="gather"), ds, f_opt)
+    rd = run_algorithm(cfg.replace(sampling_impl="dense"), ds, f_opt)
+    np.testing.assert_allclose(rd.final_models, rg.final_models, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        rd.history.objective, rg.history.objective, rtol=1e-3, atol=1e-5
+    )
+
+
+def test_sampling_auto_resolution_follows_measured_rule():
+    from distributed_optimization_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig()
+    assert cfg.resolved_sampling_impl("tpu", 49) == "dense"
+    assert cfg.resolved_sampling_impl("tpu", 500) == "gather"
+    assert cfg.resolved_sampling_impl("cpu", 49) == "gather"
+    assert cfg.replace(sampling_impl="dense").resolved_sampling_impl(
+        "cpu", 500
+    ) == "dense"
