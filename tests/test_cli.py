@@ -22,12 +22,13 @@ def test_flag_round_trip():
         ["--algorithm", "extra", "--topology", "grid", "--n-workers", "16",
          "--backend", "numpy", "--dtype", "float64", "--eval-every", "5",
          "--n-iterations", "100", "--gossip-schedule", "round_robin",
-         "--scan-unroll", "4"]
+         "--scan-unroll", "4", "--sampling-impl", "dense"]
     )
     cfg = config_from_args(args)
     assert (cfg.algorithm, cfg.topology, cfg.n_workers) == ("extra", "grid", 16)
     assert (cfg.backend, cfg.dtype, cfg.eval_every) == ("numpy", "float64", 5)
     assert (cfg.gossip_schedule, cfg.scan_unroll) == ("round_robin", 4)
+    assert cfg.sampling_impl == "dense"
     # Nonzero straggler_prob round-trips (incompatible with round_robin, so
     # a separate parse).
     args2 = build_parser().parse_args(["--straggler-prob", "0.25"])
