@@ -1,9 +1,12 @@
 """Native (C++) host-simulator backend, loaded via ctypes.
 
-The framework's native runtime tier for host-side execution: the same two
-reference algorithms the numpy oracle covers (centralized SGD and D-SGD with
-a dense mixing matrix — reference ``trainer.py:7-74``/``76-197``), compiled
-from ``native/src/gossip_core.cpp`` into a shared library (OpenMP-parallel
+The framework's native runtime tier for host-side execution: the reference's
+two algorithms (centralized SGD and D-SGD with a dense mixing matrix —
+reference ``trainer.py:7-74``/``76-197``) plus matrix-form recursions of the
+exact first-order extensions (DIGing gradient tracking, EXTRA — the same
+recursions the numpy oracle implements, giving a third independent
+implementation for cross-tier verification), compiled from
+``native/src/gossip_core.cpp`` into a shared library (OpenMP-parallel
 worker loop, stable closed-form objectives). Fidelity-sensitive work stays on
 the numpy oracle (exact reference semantics, injectable batches); this tier
 exists for fast large-N host simulation and as the C++ runtime the TPU tier
@@ -33,7 +36,8 @@ from distributed_optimization_tpu.metrics import (
 from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.utils.data import HostDataset
 
-_SUPPORTED = ("centralized", "dsgd")
+_SUPPORTED = ("centralized", "dsgd", "gradient_tracking", "extra")
+_ALGO_CODES = {"centralized": 0, "dsgd": 1, "gradient_tracking": 2, "extra": 3}
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -85,7 +89,7 @@ def load_library(rebuild: bool = False) -> ctypes.CDLL:
     lib.run_simulation.argtypes = [
         f64p, f64p, i64p,                      # X, y, offsets
         ctypes.c_int64, ctypes.c_int64, f64p,  # n_workers, d, W
-        ctypes.c_int, ctypes.c_int,            # centralized, problem
+        ctypes.c_int, ctypes.c_int,            # algorithm, problem
         ctypes.c_int64, ctypes.c_int64,        # T, batch_size
         ctypes.c_double, ctypes.c_int,         # eta0, sqrt_decay
         ctypes.c_double, ctypes.c_uint64,      # reg, seed
@@ -105,8 +109,9 @@ def run(
 ) -> BackendRunResult:
     if config.algorithm not in _SUPPORTED:
         raise ValueError(
-            f"cpp backend implements {_SUPPORTED} (the reference's algorithm "
-            f"set); {config.algorithm!r} is a jax-backend capability"
+            f"cpp backend implements {_SUPPORTED} (the reference's "
+            "algorithms plus matrix-form GT/EXTRA); "
+            f"{config.algorithm!r} is a jax-backend capability"
         )
     if (
         config.edge_drop_prob > 0.0
@@ -136,12 +141,17 @@ def run(
         floats_per_iter = centralized_floats_per_iteration(n, d)
         spectral_gap = None
     else:
+        from distributed_optimization_tpu.algorithms import get_algorithm
+
         topo = build_topology(
             config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
             seed=config.seed,
         )
         W = np.ascontiguousarray(topo.mixing_matrix, dtype=np.float64)
-        floats_per_iter = decentralized_floats_per_iteration(topo, d, 1)
+        # GT gossips both x and y per iteration (gossip_rounds=2).
+        floats_per_iter = decentralized_floats_per_iteration(
+            topo, d, get_algorithm(config.algorithm).gossip_rounds
+        )
         spectral_gap = topo.spectral_gap
 
     out_models = np.zeros((n, d), dtype=np.float64)
@@ -151,7 +161,7 @@ def run(
     start = time.perf_counter()
     rc = lib.run_simulation(
         X, y, offsets, n, d, W,
-        1 if centralized else 0,
+        _ALGO_CODES[config.algorithm],
         0 if config.problem_type == "logistic" else 1,
         T, config.local_batch_size,
         config.learning_rate_eta0,

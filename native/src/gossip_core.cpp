@@ -4,10 +4,12 @@
 // full-dataset objective evaluation every iteration (reference
 // trainer.py:41-71 centralized, trainer.py:161-193 decentralized). The numpy
 // oracle backend reproduces those semantics faithfully but pays the Python
-// interpreter per iteration; this core implements the same two algorithms
-// (centralized SGD, D-SGD with an arbitrary dense mixing matrix) as a tight
-// C++ loop behind a plain C ABI, loaded via ctypes — the framework's native
-// runtime tier for hosts (the TPU tier is XLA; see backends/cpp_backend.py).
+// interpreter per iteration; this core implements the reference's two
+// algorithms (centralized SGD, D-SGD with an arbitrary dense mixing matrix)
+// PLUS matrix-form recursions of the exact first-order extensions (DIGing
+// gradient tracking, EXTRA) as tight C++ loops behind a plain C ABI, loaded
+// via ctypes — the framework's native runtime tier for hosts (the TPU tier
+// is XLA; see backends/cpp_backend.py).
 //
 // Semantics notes:
 // - Batch sampling is without replacement via partial Fisher-Yates on a
@@ -145,12 +147,15 @@ void stochastic_gradient(int problem, const double *Xs, const double *ys,
 
 extern "C" {
 
-// Shared driver for both algorithms.
+// Shared driver for all four algorithms.
 //
 // X, y: concatenated per-worker shards, [n_total, d] row-major / [n_total];
 // offsets: [n_workers + 1] shard boundaries into X/y rows;
 // W: [n_workers, n_workers] dense mixing matrix (ignored when centralized);
-// centralized: 1 = parameter-server SGD, 0 = D-SGD;
+// algorithm: 0 = centralized (parameter-server SGD), 1 = D-SGD,
+//            2 = gradient tracking (DIGing), 3 = EXTRA — the latter two are
+//            the matrix recursions the numpy oracle also implements
+//            (backends/numpy_backend.py), for cross-tier verification;
 // sqrt_decay: 1 = eta0/sqrt(t+1), 0 = constant eta0;
 // out_models: [n_workers, d] final per-worker models (centralized: rows equal);
 // collect_metrics: 0 skips all objective/consensus evaluation (pure
@@ -161,28 +166,42 @@ extern "C" {
 // Returns 0 on success, nonzero on invalid arguments.
 int run_simulation(const double *X, const double *y, const int64_t *offsets,
                    int64_t n_workers, int64_t d, const double *W,
-                   int centralized, int problem, int64_t T,
+                   int algorithm, int problem, int64_t T,
                    int64_t batch_size, double eta0, int sqrt_decay,
                    double reg, uint64_t seed, int64_t eval_every,
                    int collect_metrics,
                    double *out_models, double *out_gap, double *out_cons) {
+  constexpr int kCentralized = 0, kDsgd = 1, kGT = 2, kExtra = 3;
   if (n_workers <= 0 || d <= 0 || T < 0 || eval_every <= 0 ||
       T % eval_every != 0 || batch_size < 0) {
     return 1;
   }
   if (problem != kLogistic && problem != kQuadratic) return 2;
+  if (algorithm < kCentralized || algorithm > kExtra) return 3;
+  const bool centralized = algorithm == kCentralized;
   const int64_t n_total = offsets[n_workers];
+  const int64_t nd = n_workers * d;
 
-  std::vector<double> models(n_workers * d, 0.0);
-  std::vector<double> grads(n_workers * d, 0.0);
-  std::vector<double> mixed(n_workers * d, 0.0);
+  std::vector<double> models(nd, 0.0);
+  std::vector<double> grads(nd, 0.0);
+  std::vector<double> mixed(nd, 0.0);
   std::vector<double> avg(d, 0.0);
+  // Extension state (allocated only when used).
+  std::vector<double> y_trk, g_prev, x_prev, Wx_prev, Wy;
+  if (algorithm == kGT) {
+    y_trk.assign(nd, 0.0);
+    g_prev.assign(nd, 0.0);
+    Wy.assign(nd, 0.0);
+  } else if (algorithm == kExtra) {
+    x_prev.assign(nd, 0.0);
+    Wx_prev.assign(nd, 0.0);
+    g_prev.assign(nd, 0.0);
+  }
 
-  for (int64_t t = 0; t < T; ++t) {
-    const double eta =
-        sqrt_decay ? eta0 / std::sqrt(static_cast<double>(t) + 1.0) : eta0;
-
-    // Local (or global) stochastic gradients.
+  // grads <- per-worker stochastic gradient at `at` (row i per worker, or
+  // the shared row 0 when `shared`), batches keyed by (seed, t, worker) —
+  // the counter-based-key design of ops/sampling.py, host-side.
+  auto compute_grads = [&](const double *at, bool shared, int64_t t) {
 #pragma omp parallel
     {
       std::vector<int64_t> scratch, idx;
@@ -191,8 +210,6 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
         const int64_t lo = offsets[i], hi = offsets[i + 1];
         const int64_t ni = hi - lo;
         const int64_t b = batch_size < ni ? batch_size : ni;
-        // Stream keyed by (seed, t, worker): reproducible, order-free —
-        // the counter-based-key design of ops/sampling.py, host-side.
         Xoshiro256ss rng(seed ^ (0x9e3779b97f4a7c15ULL * (uint64_t)(t + 1)) ^
                          (0xbf58476d1ce4e5b9ULL * (uint64_t)(i + 1)));
         if (ni > 0 && b > 0) {
@@ -200,35 +217,88 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
         } else {
           idx.clear();
         }
-        const double *params = centralized ? models.data() : models.data() + i * d;
+        const double *params = shared ? at : at + i * d;
         stochastic_gradient(problem, X + lo * d, y + lo, d, idx, params, reg,
                             grads.data() + i * d);
       }
     }
+  };
 
-    if (centralized) {
+  // out <- W @ in ([N, d] row-major).
+  auto apply_W = [&](const std::vector<double> &in, std::vector<double> &out) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n_workers; ++i) {
+      double *oi = out.data() + i * d;
+      std::memset(oi, 0, sizeof(double) * d);
+      for (int64_t j = 0; j < n_workers; ++j) {
+        const double w_ij = W[i * n_workers + j];
+        if (w_ij == 0.0) continue;
+        const double *xj = in.data() + j * d;
+        for (int64_t k = 0; k < d; ++k) oi[k] += w_ij * xj[k];
+      }
+    }
+  };
+
+  for (int64_t t = 0; t < T; ++t) {
+    const double eta =
+        sqrt_decay ? eta0 / std::sqrt(static_cast<double>(t) + 1.0) : eta0;
+
+    if (algorithm == kCentralized) {
+      compute_grads(models.data(), /*shared=*/true, t);
       // psum-mean of worker gradients, step the (shared) row-0 model.
       for (int64_t i = 1; i < n_workers; ++i)
         for (int64_t k = 0; k < d; ++k) grads[k] += grads[i * d + k];
       const double inv_n = 1.0 / static_cast<double>(n_workers);
       for (int64_t k = 0; k < d; ++k)
         models[k] -= eta * grads[k] * inv_n;
-    } else {
-      // Gossip: mixed = W @ models, then the local SGD step.
+    } else if (algorithm == kDsgd) {
+      // D-PSGD: grads at local x_t (pre-mix), x_{t+1} = W x_t - eta g_t.
+      compute_grads(models.data(), /*shared=*/false, t);
+      apply_W(models, mixed);
 #pragma omp parallel for schedule(static)
       for (int64_t i = 0; i < n_workers; ++i) {
         double *mi = mixed.data() + i * d;
-        std::memset(mi, 0, sizeof(double) * d);
-        for (int64_t j = 0; j < n_workers; ++j) {
-          const double w_ij = W[i * n_workers + j];
-          if (w_ij == 0.0) continue;
-          const double *xj = models.data() + j * d;
-          for (int64_t k = 0; k < d; ++k) mi[k] += w_ij * xj[k];
-        }
         const double *gi = grads.data() + i * d;
         for (int64_t k = 0; k < d; ++k) mi[k] -= eta * gi[k];
       }
       models.swap(mixed);
+    } else if (algorithm == kGT) {
+      // DIGing: x_{t+1} = W x_t - eta y_t; y_{t+1} = W y_t + g_{t+1} - g_t
+      // (y_0 = g_prev = 0 -> pure gossip first step). Matches
+      // numpy_backend's matrix form and the jax step rule.
+      apply_W(models, mixed);
+      for (int64_t r = 0; r < nd; ++r) mixed[r] -= eta * y_trk[r];
+      models.swap(mixed);
+      compute_grads(models.data(), /*shared=*/false, t);
+      apply_W(y_trk, Wy);
+      for (int64_t r = 0; r < nd; ++r) {
+        y_trk[r] = Wy[r] + grads[r] - g_prev[r];
+        g_prev[r] = grads[r];
+      }
+    } else {  // kExtra
+      // EXTRA: x_1 = W x_0 - eta g(x_0);
+      // x_{t+1} = x_t + W x_t - (x_{t-1} + W x_{t-1})/2 - eta (g_t - g_{t-1}).
+      // Wx_prev carries the previous iteration's mix (one mix per step).
+      compute_grads(models.data(), /*shared=*/false, t);
+      apply_W(models, mixed);  // mixed = W x_t
+      if (t == 0) {
+        for (int64_t r = 0; r < nd; ++r) {
+          x_prev[r] = models[r];
+          Wx_prev[r] = mixed[r];
+          g_prev[r] = grads[r];
+          models[r] = mixed[r] - eta * grads[r];
+        }
+      } else {
+        for (int64_t r = 0; r < nd; ++r) {
+          const double x_new = models[r] + mixed[r] -
+                               0.5 * (x_prev[r] + Wx_prev[r]) -
+                               eta * (grads[r] - g_prev[r]);
+          x_prev[r] = models[r];
+          Wx_prev[r] = mixed[r];
+          g_prev[r] = grads[r];
+          models[r] = x_new;
+        }
+      }
     }
 
     if (collect_metrics && (t + 1) % eval_every == 0) {
