@@ -2,7 +2,7 @@
 
 How does the framework scale in N — the honest scaling axis for this problem
 family (SURVEY.md §5.7: the worker graph is the structural analog of sequence
-parallelism)? Sweeps N ∈ {25, 64, 256, 1024} on the headline config (D-SGD,
+parallelism)? Sweeps N ∈ {25, 64, 256, 1024, 4096} on the headline config (D-SGD,
 ring, logistic, T=10k, parity eval cadence k=1) and records
 
 - **iters/sec** (fused scan, best-of-2 per N, interleaved to blunt co-tenant
@@ -11,7 +11,7 @@ ring, logistic, T=10k, parity eval cadence k=1) and records
   topology's spectral gap, which sets the rate), and
 - the CPU reference-semantics simulator's iters/sec at the same N (the
   baseline the ≥50x north star is measured against), for N ≤ 256 (the numpy
-  loop at N=1024 would take minutes for no additional insight; it scales
+  loop at N ≥ 1024 would take minutes for no additional insight; it scales
   ~1/N).
 
 Artifacts: ``docs/perf/scaling.json`` + ``docs/figures/scaling.png`` + a
@@ -34,7 +34,7 @@ from distributed_optimization_tpu.config import ExperimentConfig
 from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
 from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
 
-NS = (25, 64, 256, 1024)
+NS = (25, 64, 256, 1024, 4096)
 T = 10_000
 CYCLES = 2
 

@@ -92,3 +92,46 @@ def test_compression_is_contraction(d, data, name):
     else:
         assert np.all(err <= total + 1e-6)
     assert np.all(np.count_nonzero(q, axis=1) <= k)
+
+
+@settings(**SETTINGS)
+@given(
+    n_workers=st.integers(min_value=1, max_value=12),
+    n_local=st.integers(min_value=1, max_value=40),
+    batch=st.integers(min_value=1, max_value=48),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+    step=st.integers(min_value=0, max_value=10_000),
+    data=st.data(),
+)
+def test_dense_sampling_subset_identity(n_workers, n_local, batch, seed, step, data):
+    """For ANY (shapes, key, step, ragged n_valid): the dense weight vectors
+    select exactly the rows the gather path's top-k selects, with weight
+    1/b_eff each (the structural invariant behind sampling_impl='dense')."""
+    from distributed_optimization_tpu.ops.sampling import (
+        _worker_keys,
+        sample_batch_indices,
+        sample_worker_batch_weights,
+    )
+
+    n_valid = jnp.asarray(
+        [data.draw(st.integers(min_value=0, max_value=n_local))
+         for _ in range(n_workers)],
+        dtype=jnp.int32,
+    )
+    key = jax.random.key(seed)
+    dense = np.asarray(
+        sample_worker_batch_weights(key, step, n_valid, n_local, batch)
+    )
+    worker_keys = _worker_keys(key, step, n_workers)
+    for i in range(n_workers):
+        idx, w = sample_batch_indices(worker_keys[i], n_local, n_valid[i], batch)
+        gather_rows = np.unique(np.asarray(idx)[np.asarray(w) > 0])
+        dense_rows = np.nonzero(dense[i] > 0)[0]
+        np.testing.assert_array_equal(np.sort(dense_rows), gather_rows)
+        eff = min(batch, int(n_valid[i]), n_local)
+        if eff > 0:
+            np.testing.assert_allclose(dense[i][dense_rows], 1.0 / eff, rtol=1e-6)
+            assert dense_rows.size == eff
+            np.testing.assert_allclose(dense[i].sum(), 1.0, rtol=1e-5)
+        else:
+            assert dense_rows.size == 0
