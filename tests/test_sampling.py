@@ -144,3 +144,31 @@ def test_sampling_auto_resolution_follows_measured_rule():
     assert cfg.replace(sampling_impl="dense").resolved_sampling_impl(
         "cpu", 500
     ) == "dense"
+
+
+def test_dense_sampling_composes_with_worker_mesh():
+    """Dense sampling on the 8-virtual-device mesh partitions cleanly (the
+    [N, L] weights and full-shard weighted gradients are worker-sharded) and
+    matches the single-device dense trajectory."""
+    import numpy as np
+
+    from conftest import small_backend_config
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.parallel.mesh import make_worker_mesh
+    from distributed_optimization_tpu.utils import (
+        compute_reference_optimum,
+        generate_synthetic_dataset,
+    )
+
+    cfg = small_backend_config(n_iterations=40, sampling_impl="dense")
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    mesh = make_worker_mesh(cfg.n_workers)
+    r_mesh = jax_backend.run(cfg, ds, f_opt, mesh=mesh)
+    r_single = jax_backend.run(cfg, ds, f_opt, use_mesh=False)
+    np.testing.assert_allclose(
+        r_mesh.final_models, r_single.final_models, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        r_mesh.history.objective, r_single.history.objective, rtol=1e-4, atol=1e-6
+    )
