@@ -94,7 +94,7 @@ def load_library(rebuild: bool = False) -> ctypes.CDLL:
         ctypes.c_double, ctypes.c_int,         # eta0, sqrt_decay
         ctypes.c_double, ctypes.c_uint64,      # reg, seed
         ctypes.c_int64, ctypes.c_int,          # eval_every, collect_metrics
-        f64p, f64p, f64p,                      # out_models, out_gap, out_cons
+        f64p, f64p, f64p, f64p,                # out_models/gap/cons/times
     ]
     _lib = lib
     return lib
@@ -157,6 +157,7 @@ def run(
     out_models = np.zeros((n, d), dtype=np.float64)
     out_gap = np.full(n_evals, np.nan)
     out_cons = np.full(n_evals, np.nan)
+    out_times = np.full(n_evals, np.nan)
 
     start = time.perf_counter()
     rc = lib.run_simulation(
@@ -168,7 +169,7 @@ def run(
         1 if config.resolved_lr_schedule() == "sqrt_decay" else 0,
         config.reg_param, config.seed, eval_every,
         1 if collect_metrics else 0,
-        out_models, out_gap, out_cons,
+        out_models, out_gap, out_cons, out_times,
     )
     run_seconds = time.perf_counter() - start
     if rc != 0:
@@ -180,7 +181,10 @@ def run(
     history = RunHistory(
         objective=out_gap - f_opt,
         consensus_error=out_cons if track_consensus else None,
-        time=np.linspace(run_seconds / max(n_evals, 1), run_seconds, n_evals),
+        # The core stamps steady_clock at every eval boundary (parity with
+        # the reference's per-iteration time.time() samples, trainer.py:63).
+        time=out_times,
+        time_measured=True,
         eval_iterations=np.arange(eval_every, T + 1, eval_every),
         total_floats_transmitted=floats_per_iter * T,
         iters_per_second=T / run_seconds if run_seconds > 0 else float("inf"),

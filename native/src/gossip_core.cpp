@@ -21,6 +21,7 @@
 //   ops/losses_np.py (stable softplus for logistic).
 // - float64 throughout, like the numpy oracle.
 
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -162,7 +163,11 @@ extern "C" {
 //            iteration throughput; out_gap/out_cons left untouched);
 // out_gap:   [T / eval_every] full-data objective values (NOT gap; caller
 //            subtracts f_opt host-side);
-// out_cons:  [T / eval_every] consensus error, untouched when centralized.
+// out_cons:  [T / eval_every] consensus error, untouched when centralized;
+// out_times: [T / eval_every] MEASURED wall-clock seconds since run start at
+//            each eval boundary (always filled — the numpy oracle and the
+//            jax measured-timestamps path record the same thing, reference
+//            trainer.py:63,181).
 // Returns 0 on success, nonzero on invalid arguments.
 int run_simulation(const double *X, const double *y, const int64_t *offsets,
                    int64_t n_workers, int64_t d, const double *W,
@@ -170,7 +175,8 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
                    int64_t batch_size, double eta0, int sqrt_decay,
                    double reg, uint64_t seed, int64_t eval_every,
                    int collect_metrics,
-                   double *out_models, double *out_gap, double *out_cons) {
+                   double *out_models, double *out_gap, double *out_cons,
+                   double *out_times) {
   constexpr int kCentralized = 0, kDsgd = 1, kGT = 2, kExtra = 3;
   if (n_workers <= 0 || d <= 0 || T < 0 || eval_every <= 0 ||
       T % eval_every != 0 || batch_size < 0) {
@@ -239,6 +245,8 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
     }
   };
 
+  const auto run_start = std::chrono::steady_clock::now();
+
   for (int64_t t = 0; t < T; ++t) {
     const double eta =
         sqrt_decay ? eta0 / std::sqrt(static_cast<double>(t) + 1.0) : eta0;
@@ -301,11 +309,16 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       }
     }
 
-    if (collect_metrics && (t + 1) % eval_every == 0) {
+    if ((t + 1) % eval_every == 0) {
       const int64_t row = (t + 1) / eval_every - 1;
-      if (centralized) {
+      out_times[row] = std::chrono::duration<double>(
+                           std::chrono::steady_clock::now() - run_start)
+                           .count();
+      if (!collect_metrics) {
+        // timestamps only; objective/consensus evaluation skipped
+      } else if (centralized) {
         out_gap[row] = full_objective(problem, X, y, n_total, d, models.data(), reg);
-      } else {
+      } else {  // decentralized metrics
         std::memset(avg.data(), 0, sizeof(double) * d);
         for (int64_t i = 0; i < n_workers; ++i)
           for (int64_t k = 0; k < d; ++k) avg[k] += models[i * d + k];

@@ -159,3 +159,13 @@ def test_gt_stochastic_tracks_numpy_curve(data):
     tail_c = float(np.mean(rc.history.objective[-50:]))
     tail_n = float(np.mean(rn.history.objective[-50:]))
     assert abs(tail_c - tail_n) < 0.5 * max(abs(tail_n), 1e-3) + 1e-3
+
+
+def test_cpp_timestamps_are_measured(data):
+    ds, f_opt = data
+    r = cpp_backend.run(CFG.replace(n_iterations=100, eval_every=10), ds, f_opt)
+    assert r.history.time_measured
+    t = r.history.time
+    assert t.shape == (10,)
+    assert np.all(np.isfinite(t)) and np.all(t > 0)
+    assert np.all(np.diff(t) > 0)
