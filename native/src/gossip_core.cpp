@@ -311,11 +311,8 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
 
     if ((t + 1) % eval_every == 0) {
       const int64_t row = (t + 1) / eval_every - 1;
-      out_times[row] = std::chrono::duration<double>(
-                           std::chrono::steady_clock::now() - run_start)
-                           .count();
       if (!collect_metrics) {
-        // timestamps only; objective/consensus evaluation skipped
+        // objective/consensus evaluation skipped; timestamp still stamped
       } else if (centralized) {
         out_gap[row] = full_objective(problem, X, y, n_total, d, models.data(), reg);
       } else {  // decentralized metrics
@@ -335,6 +332,13 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
         }
         out_cons[row] = ce * inv_n;
       }
+      // Stamp AFTER the metrics computation, matching the numpy oracle and
+      // the jax chunked path (both include the eval cost in the boundary's
+      // timestamp) — stamping before would bias cross-backend time-to-eps
+      // comparisons by one full-data eval per boundary.
+      out_times[row] = std::chrono::duration<double>(
+                           std::chrono::steady_clock::now() - run_start)
+                           .count();
     }
   }
 
