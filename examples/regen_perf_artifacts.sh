@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Regenerate every committed performance artifact on the real chip.
+#
+# Each script is independent and idempotent; together they rebuild all of
+# docs/perf/*.json, docs/figures/scaling.png, and the numbers quoted in
+# docs/PERF.md. Budget ~45-60 min of chip time end to end (the shared
+# tunnel's co-tenant load makes absolute numbers vary 2-3x between runs;
+# every script interleaves its variants so within-artifact comparisons
+# stay meaningful).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python examples/bench_mixing.py            # -> docs/perf/mixing_bench.json
+python examples/bench_breakdown.py         # -> docs/perf/breakdown.json
+python examples/bench_scaling.py           # -> docs/perf/scaling.json + figure
+python examples/northstar_consensus.py --ring-full  # -> docs/perf/northstar_consensus.json
+python bench.py                            # headline JSON line (stdout)
