@@ -250,15 +250,17 @@ def _resolve_auto_mixing_impl(config, topo, algo, mesh, platform: str) -> str:
     """Resolve ``mixing_impl='auto'`` from measured data.
 
     On a single real TPU chip the hand-fused pallas ring kernel (one VMEM pass
-    for W x − ηg) measured fastest end-to-end for the canonical D-SGD update —
-    5,080 vs 4,184 iters/sec for the XLA roll-stencil at N=256
-    (``docs/perf/mixing_bench.json``, produced by ``examples/bench_mixing.py``
-    on TPU v5e). Pick it exactly where that measurement applies: TPU, no
-    multi-device mesh (a pallas_call is an opaque custom call GSPMD cannot
-    partition), ring with the fused-step consumer (dsgd), static synchronous
-    topology (the fault machinery bypasses the mixing op anyway), float32.
-    Everything else keeps the round-1 rule: stencil where the graph embeds as
-    mesh shifts, dense for irregular graphs (``ops/mixing.py``).
+    for W x − ηg) measured fastest end-to-end in the gather-sampling era
+    (5,080 vs 4,184 iters/sec for the XLA roll-stencil at N=256); after the
+    dense-sampling change removed the mixing bottleneck, pallas and stencil
+    tie within chip noise (46.2k vs 47.6k interleaved at T=10k —
+    ``docs/perf/mixing_bench.json``), so the pallas pick is kept for the
+    envelope where it never measured worse: TPU, no multi-device mesh (a
+    pallas_call is an opaque custom call GSPMD cannot partition), ring with
+    the fused-step consumer (dsgd), static synchronous topology (the fault
+    machinery bypasses the mixing op anyway), float32. Everything else keeps
+    the round-1 rule: stencil where the graph embeds as mesh shifts, dense
+    for irregular graphs (``ops/mixing.py``).
     """
     if config.mixing_impl != "auto":
         return config.mixing_impl

@@ -56,7 +56,9 @@ def _time_op(fn, x, k: int = 2000, repeats: int = 3) -> float:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--iters", type=int, default=3000)
+    # Default matches the committed artifact (docs/perf/mixing_bench.json was
+    # produced at T=10k) so regen_perf_artifacts.sh reproduces it.
+    ap.add_argument("--iters", type=int, default=10000)
     ap.add_argument("--n-workers", type=int, default=256)
     ap.add_argument("--op-chain", type=int, default=2000)
     ap.add_argument("--repeats", type=int, default=3)
@@ -111,25 +113,33 @@ def main() -> None:
     ds = generate_synthetic_dataset(cfg0)
     _, f_opt = compute_reference_optimum(ds, cfg0.reg_param)
 
+    # Variants are INTERLEAVED round-robin across repeat cycles so co-tenant
+    # load swings on the shared chip hit every impl comparably — sequential
+    # per-impl repeats let a single busy window sink one impl's numbers.
     e2e = {}
-    for impl in ("stencil", "pallas", "dense", "shard_map"):
-        cfg = cfg0.replace(mixing_impl=impl)
-        try:
-            kwargs = {}
-            if impl == "shard_map":
-                kwargs["mesh"] = mesh
-            best_ips, gap = 0.0, None
-            for _ in range(args.repeats):
+    best: dict[str, float] = {}
+    gaps: dict[str, float] = {}
+    for _ in range(args.repeats):
+        for impl in ("stencil", "pallas", "dense", "shard_map"):
+            if impl in e2e:  # already failed; don't retry every cycle
+                continue
+            cfg = cfg0.replace(mixing_impl=impl)
+            kwargs = {"mesh": mesh} if impl == "shard_map" else {}
+            try:
                 r = jax_backend.run(cfg, ds, f_opt, **kwargs)
-                best_ips = max(best_ips, r.history.iters_per_second)
-                gap = float(r.history.objective[-1])
-            e2e[impl] = {"iters_per_sec": round(best_ips, 1),
-                         "final_gap": round(gap, 6)}
-            print(f"[bench_mixing] e2e {impl:10s}: {best_ips:9.0f} iters/sec "
-                  f"(gap {gap:.4f})", file=sys.stderr)
-        except Exception as e:  # pragma: no cover - informational
-            e2e[impl] = {"error": f"{type(e).__name__}: {e}"[:200]}
-            print(f"[bench_mixing] e2e {impl}: FAILED {e}", file=sys.stderr)
+                best[impl] = max(best.get(impl, 0.0),
+                                 float(r.history.iters_per_second))
+                gaps[impl] = float(r.history.objective[-1])
+            except Exception as e:  # pragma: no cover - informational
+                e2e[impl] = {"error": f"{type(e).__name__}: {e}"[:200]}
+                print(f"[bench_mixing] e2e {impl}: FAILED {e}", file=sys.stderr)
+    for impl, ips in best.items():
+        if impl in e2e:  # failed in a later cycle: the error record stands
+            continue
+        e2e[impl] = {"iters_per_sec": round(ips, 1),
+                     "final_gap": round(gaps[impl], 6)}
+        print(f"[bench_mixing] e2e {impl:10s}: {ips:9.0f} iters/sec "
+              f"(gap {gaps[impl]:.4f})", file=sys.stderr)
 
     # shard_map on one chip is a degenerate lower bound (its ppermutes never
     # cross a device boundary) and can't be what 'auto' picks single-chip, so
