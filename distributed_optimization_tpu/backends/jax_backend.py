@@ -56,6 +56,12 @@ from distributed_optimization_tpu.parallel.mesh import (
 from distributed_optimization_tpu.utils.data import HostDataset, stack_shards
 
 
+# Auto-routing thresholds for coarse eval cadences (see the routing comment
+# in ``_run``; module-level so tests can exercise the predicate cheaply).
+COARSE_CADENCE_EVAL_EVERY = 50_000
+COARSE_CADENCE_MIN_ROWS = 100_000_000  # per-chunk gradient rows, k·N·b_eff
+
+
 def make_full_objective_fn(problem, reg):
     """Full-dataset objective of a single model w, computed from the stacked
     per-worker shards (so it shards over the mesh and reduces with one psum).
@@ -219,7 +225,7 @@ def run(
     collect_metrics: bool = True,
     measure_compile: bool = True,
     checkpoint=None,
-    measure_timestamps: bool = False,
+    measure_timestamps: Optional[bool] = None,
 ) -> BackendRunResult:
     """Run one experiment on the JAX backend; returns histories + final models.
 
@@ -227,7 +233,10 @@ def run(
     recording a real ``perf_counter`` timestamp per eval (one host sync per
     ``eval_every`` iterations) instead of the fully fused scan; the returned
     history then carries measured wall-clock (``time_measured=True``) rather
-    than a linspace interpolation of the total run time.
+    than a linspace interpolation of the total run time. The default
+    ``None`` resolves automatically: coarse cadences with enough per-chunk
+    work route to the chunked loop (faster there AND measured — see the
+    routing rule in ``_run``); pass ``False`` to force the fused scan.
 
     A float64 config runs under a scoped ``enable_x64`` — without it jax
     silently truncates every array to float32, defeating the fidelity dtype.
@@ -293,7 +302,7 @@ def _run(
     collect_metrics: bool = True,
     measure_compile: bool = True,
     checkpoint=None,
-    measure_timestamps: bool = False,
+    measure_timestamps: Optional[bool] = None,
 ) -> BackendRunResult:
     """Backend implementation (see ``run``).
 
@@ -558,6 +567,25 @@ def _run(
         return chunk
 
     n_evals = T // eval_every
+
+    # measure_timestamps=None (the default) resolves automatically: very
+    # coarse eval cadences run FASTER under the host-driven chunk loop than
+    # under the fused nested scan (the fused path dips ~2-3x at k>=100 —
+    # docs/PERF.md §3 anomaly note — while the chunked loop measured 125k
+    # iters/sec at k=100k on the 40M-iteration ring run), provided each
+    # chunk computes long enough to amortize its ~0.3s host sync. The
+    # per-chunk gradient-row volume k·N·b_eff >= 1e8 marks the benchmarked
+    # scale (~2e8 at the N=256 headline with k=50k; b_eff clamps the
+    # configured batch to the shard length, matching the sampler); small
+    # problems keep the fused scan. Explicit True/False always wins — False
+    # is the only way to measure the fused path at coarse cadence (e.g. to
+    # regenerate the anomaly data).
+    if measure_timestamps is None:
+        effective_batch = min(config.local_batch_size, device_data.X.shape[1])
+        measure_timestamps = (
+            eval_every >= COARSE_CADENCE_EVAL_EVERY
+            and eval_every * n * effective_batch >= COARSE_CADENCE_MIN_ROWS
+        )
 
     if checkpoint is None and not measure_timestamps:
         def run_scan(state_init, data):

@@ -187,11 +187,15 @@ def build_parser() -> argparse.ArgumentParser:
                       help="start fresh even if DIR holds a checkpoint")
 
     diag = p.add_argument_group("profiling / diagnostics")
-    diag.add_argument("--measure-time", action="store_true",
+    diag.add_argument("--measure-time", action=argparse.BooleanOptionalAction,
+                      default=None,
                       help="record real per-eval wall-clock timestamps "
                            "(host-driven chunk loop; one sync per eval) "
                            "instead of interpolating the fused scan's total "
-                           "(jax backend)")
+                           "(jax backend). Default: automatic — coarse eval "
+                           "cadences with enough per-chunk work route to the "
+                           "measured chunk loop; --no-measure-time forces "
+                           "the fused scan")
     diag.add_argument("--profile-dir", metavar="DIR", default=None,
                       help="collect a jax.profiler (XProf/TensorBoard) trace "
                            "of the run into DIR")
@@ -327,16 +331,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             every_evals=args.checkpoint_every,
             resume=not args.no_resume,
         )
-    if args.measure_time:
+    if args.measure_time is not None:
         if args.backend == "jax":
-            run_kwargs["measure_timestamps"] = True
-        elif args.backend == "cpp":
+            run_kwargs["measure_timestamps"] = args.measure_time
+        elif not args.measure_time:
             raise SystemExit(
-                "--measure-time is unsupported on the cpp backend (the "
-                "native core runs the whole horizon in one call); the numpy "
-                "backend always measures per-eval timestamps"
+                "--no-measure-time only applies to the jax backend's fused "
+                "scan; the numpy and cpp backends always record measured "
+                "per-eval timestamps"
             )
-        # numpy: already measured, flag is a no-op.
+        # numpy/cpp with --measure-time: already measured, flag is a no-op.
 
     if args.preflight:
         from distributed_optimization_tpu.utils.diagnostics import check_collectives

@@ -102,3 +102,47 @@ def test_report_marks_interpolated_seconds(data):
 
     text = format_report([record("timed", timed)], cfg, f_opt)
     assert "interpolated" not in text
+
+
+def test_coarse_cadence_auto_routes_to_chunked_loop(data, monkeypatch):
+    """measure_timestamps=None (the default) routes coarse cadences with
+    enough per-chunk work (k >= COARSE_CADENCE_EVAL_EVERY and clamped
+    gradient-row volume k*N*b_eff >= COARSE_CADENCE_MIN_ROWS) through the
+    host-chunked loop — which outruns the fused nested scan there (PERF.md
+    §3 anomaly note) and reports measured timestamps. Small problems and
+    explicit False keep the fused scan. Thresholds are patched down so the
+    predicate is exercised with 60-iteration runs."""
+    ds, f_opt = data
+    monkeypatch.setattr(jax_backend, "COARSE_CADENCE_EVAL_EVERY", 20)
+    # CFG is N=8, shards of 40 rows; b=8 → clamped volume 20*8*8 = 1280.
+    monkeypatch.setattr(jax_backend, "COARSE_CADENCE_MIN_ROWS", 1000)
+    cfg = CFG.replace(n_iterations=60, eval_every=20, local_batch_size=8)
+    res = jax_backend.run(cfg, ds, f_opt)
+    assert res.history.time_measured  # chunked path engaged automatically
+    assert res.history.objective.shape == (3,)
+    # Explicit False forces the fused scan (the only way to measure it at
+    # coarse cadence).
+    forced = jax_backend.run(cfg, ds, f_opt, measure_timestamps=False)
+    assert not forced.history.time_measured
+    # Below the volume threshold (b=1 → 160 rows/chunk): fused by default.
+    small = jax_backend.run(cfg.replace(local_batch_size=1), ds, f_opt)
+    assert not small.history.time_measured
+    # Below the cadence threshold: fused by default; same trajectory at the
+    # shared eval points.
+    fine = jax_backend.run(cfg.replace(eval_every=10), ds, f_opt)
+    assert not fine.history.time_measured
+    np.testing.assert_allclose(
+        res.history.objective, fine.history.objective[1::2], rtol=1e-5,
+        atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        res.final_models, fine.final_models, rtol=1e-6, atol=1e-8
+    )
+    # The clamp: a huge configured batch on 40-row shards must not count as
+    # huge volume (b_eff = 40 ⇒ 20*8*40 = 6400 ≥ 1000 routes, but with the
+    # real 1e8 threshold restored it must NOT).
+    monkeypatch.setattr(jax_backend, "COARSE_CADENCE_MIN_ROWS", 10_000)
+    clamped = jax_backend.run(
+        cfg.replace(local_batch_size=10_000), ds, f_opt
+    )
+    assert not clamped.history.time_measured  # 6400 < 10_000 despite b=10k
