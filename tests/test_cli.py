@@ -118,3 +118,21 @@ def test_main_digits_dataset(tmp_path):
     assert rc == 0
     blob = json.loads(json_out.read_text())
     assert blob["runs"][0]["history"]["objective"]
+
+
+def test_measure_time_flags(tmp_path):
+    """--measure-time / --no-measure-time round-trip: jax honors both; the
+    host simulators (always measured) reject the meaningless negative."""
+    import pytest
+
+    from distributed_optimization_tpu.cli import main
+
+    rc = main(_TINY + ["--measure-time", "--json", str(tmp_path / "a.json")])
+    assert rc == 0
+    rc = main(_TINY + ["--no-measure-time", "--json", str(tmp_path / "b.json")])
+    assert rc == 0
+    with pytest.raises(SystemExit, match="always record measured"):
+        main(_TINY + ["--backend", "numpy", "--no-measure-time"])
+    # positive flag is a harmless no-op on the already-measured backends
+    rc = main(_TINY + ["--backend", "numpy", "--measure-time"])
+    assert rc == 0
