@@ -59,7 +59,13 @@ from distributed_optimization_tpu.utils.data import HostDataset, stack_shards
 # Auto-routing thresholds for coarse eval cadences (see the routing comment
 # in ``_run``; module-level so tests can exercise the predicate cheaply).
 COARSE_CADENCE_EVAL_EVERY = 50_000
-COARSE_CADENCE_MIN_ROWS = 100_000_000  # per-chunk gradient rows, k·N·b_eff
+COARSE_CADENCE_MIN_ROWS = 100_000_000  # per-chunk gradient rows actually computed
+
+# Forcing --sampling-impl dense beyond this padded shard length warns: the
+# [L, L] ranking matrix is quadratic and the measured crossover to gather is
+# ~L=250 (docs/perf/breakdown.json). Single source for the backend warning
+# and the CLI help.
+DENSE_SAMPLING_WARN_ROWS = 256
 
 
 def make_full_objective_fn(problem, reg):
@@ -431,6 +437,22 @@ def _run(
     sampling_impl = config.resolved_sampling_impl(
         jax.devices()[0].platform, device_data.X.shape[1]
     )
+    if (
+        config.sampling_impl == "dense"
+        and device_data.X.shape[1] > DENSE_SAMPLING_WARN_ROWS
+    ):
+        import warnings
+
+        # The auto rule gates dense to L <= 64 and the measured crossover to
+        # gather is around L ~ 250 (docs/perf/breakdown.json); an explicit
+        # force beyond that silently pays the [L, L] ranking matrix.
+        warnings.warn(
+            f"--sampling-impl dense builds an [L, L] per-worker ranking "
+            f"matrix every iteration (O(N·L²) work/memory); at L = "
+            f"{device_data.X.shape[1]} rows the measured crossover favors "
+            "'gather' — forcing dense anyway as requested",
+            stacklevel=2,
+        )
 
     # Sharded arrays are threaded through jit as ARGUMENTS, never captured:
     # a traced function that closes over an array spanning non-addressable
@@ -574,17 +596,24 @@ def _run(
     # docs/PERF.md §3 anomaly note — while the chunked loop measured 125k
     # iters/sec at k=100k on the 40M-iteration ring run), provided each
     # chunk computes long enough to amortize its ~0.3s host sync. The
-    # per-chunk gradient-row volume k·N·b_eff >= 1e8 marks the benchmarked
-    # scale (~2e8 at the N=256 headline with k=50k; b_eff clamps the
-    # configured batch to the shard length, matching the sampler); small
-    # problems keep the fused scan. Explicit True/False always wins — False
-    # is the only way to measure the fused path at coarse cadence (e.g. to
-    # regenerate the anomaly data).
+    # per-chunk gradient-row volume k·rows >= 1e8 marks the benchmarked
+    # scale (~2e8 at the N=256 headline with k=50k); small problems keep the
+    # fused scan. ``rows`` counts rows the device actually COMPUTES per
+    # iteration under the resolved sampling impl: the dense-weights path
+    # touches every padded shard row (N·L), and the gather path materializes
+    # a static [N, b, d] batch — indices are tiled up to batch_size
+    # (ops/sampling.py jnp.resize), so padded/tiled rows are real FLOPs even
+    # though they carry zero weight; no n_valid clamp applies. Explicit
+    # True/False always wins — False is the only way to measure the fused
+    # path at coarse cadence (e.g. to regenerate the anomaly data).
     if measure_timestamps is None:
-        effective_batch = min(config.local_batch_size, device_data.X.shape[1])
+        if sampling_impl == "dense":
+            rows_per_iter = n * device_data.X.shape[1]
+        else:
+            rows_per_iter = n * config.local_batch_size
         measure_timestamps = (
             eval_every >= COARSE_CADENCE_EVAL_EVERY
-            and eval_every * n * effective_batch >= COARSE_CADENCE_MIN_ROWS
+            and eval_every * rows_per_iter >= COARSE_CADENCE_MIN_ROWS
         )
 
     if checkpoint is None and not measure_timestamps:

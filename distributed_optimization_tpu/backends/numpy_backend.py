@@ -12,13 +12,18 @@ gossip, full-dataset objective evaluated on the host every iteration — so it
 
 Covers the two algorithms the reference implements (centralized SGD,
 D-SGD) via the same shared step rules the JAX backend uses, plus
-INDEPENDENT matrix-form host implementations of the exact first-order
-extensions (gradient tracking and EXTRA) written directly from their
-published recursions (Nedić-Olshevsky-Shi 2017 eq. DIGing; Shi-Ling-Wu-Yin
-2015 eq. 2.13) rather than through the shared ``Algorithm.step`` rules —
-so they serve as a long-horizon fixed-point oracle for the JAX backend
-(SURVEY.md §4c backend-equivalence strategy). ADMM/CHOCO remain
-JAX-backend-only capabilities.
+INDEPENDENT matrix-form host implementations of every extension written
+directly from the published recursions rather than through the shared
+``Algorithm.step`` rules — gradient tracking (Nedić-Olshevsky-Shi 2017,
+DIGing), EXTRA (Shi-Ling-Wu-Yin 2015 eq. 2.13), decentralized linearized
+ADMM (Ling-Shi-Wu-Ribeiro 2015, DLM; half-Laplacian matrix form), and
+CHOCO-SGD (Koloskova-Stich-Jaggi 2019, Algorithm 2 matrix form) — so all
+six algorithms have a long-horizon fixed-point / trajectory oracle for the
+JAX backend (SURVEY.md §4c backend-equivalence strategy). The only CHOCO
+restriction: randomized compressors (random_k, qsgd) draw from the JAX
+counter-based PRNG inside the step, which a host oracle cannot reproduce
+without importing the very code under test — the deterministic compressors
+(none, top_k) are supported and are the measured configurations.
 """
 
 from __future__ import annotations
@@ -41,11 +46,25 @@ from distributed_optimization_tpu.ops import losses_np
 from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.utils.data import HostDataset
 
-_SUPPORTED = ("centralized", "dsgd", "gradient_tracking", "extra")
+_SUPPORTED = (
+    "centralized", "dsgd", "gradient_tracking", "extra", "admm", "choco"
+)
 
 # Algorithms with a dedicated matrix-form host implementation below,
 # independent of the shared ``Algorithm.step`` rules the JAX backend runs.
-_MATRIX_FORM = ("gradient_tracking", "extra")
+_MATRIX_FORM = ("gradient_tracking", "extra", "admm", "choco")
+
+
+def _topk_rows(v: np.ndarray, k: int) -> np.ndarray:
+    """Per-row top-k-by-magnitude compressor (Koloskova et al. '19 §2, the
+    deterministic contraction): keep the k largest |v| entries per row, zero
+    the rest. Ties break toward the lower index (a stable descending sort),
+    matching ``lax.top_k`` so the two backends select identical supports."""
+    out = np.zeros_like(v)
+    for r in range(v.shape[0]):
+        keep = np.argsort(-np.abs(v[r]), kind="stable")[:k]
+        out[r, keep] = v[r, keep]
+    return out
 
 
 def run(
@@ -83,6 +102,13 @@ def run(
     shards = [dataset.shard(i) for i in range(n)]
     shard_sizes = [Xi.shape[0] for Xi, _ in shards]
 
+    if config.algorithm == "choco" and config.compression in ("random_k", "qsgd"):
+        raise ValueError(
+            "the numpy CHOCO oracle supports the deterministic compressors "
+            "(none, top_k); random_k/qsgd draw from the jax counter-based "
+            "PRNG inside the step, which an independent host implementation "
+            "cannot reproduce without importing the code under test"
+        )
     if algo.is_decentralized:
         topo = build_topology(
             config.topology, n, erdos_renyi_p=config.erdos_renyi_p, seed=config.seed
@@ -90,9 +116,16 @@ def run(
         W = topo.mixing_matrix
         A = topo.adjacency
         degrees = topo.degrees[:, None]
-        floats_per_iter = decentralized_floats_per_iteration(
-            topo, d, algo.gossip_rounds
-        )
+        if algo.comm_payload is not None:
+            # Compressed gossip transmits the compressor's payload per edge
+            # (same accounting as the jax backend).
+            floats_per_iter = topo.floats_per_iteration * algo.comm_payload(
+                config, d
+            )
+        else:
+            floats_per_iter = decentralized_floats_per_iteration(
+                topo, d, algo.gossip_rounds
+            )
         spectral_gap = topo.spectral_gap
     else:
         topo, W, A = None, None, None
@@ -142,7 +175,7 @@ def run(
                     "g": g_new,
                 }
 
-        else:  # extra
+        elif config.algorithm == "extra":
             # EXTRA (Shi et al. 2015):
             #   x_1     = W x_0 − η g(x_0)
             #   x_{t+1} = (I+W) x_t − (I+W)/2 x_{t−1} − η (g(x_t) − g(x_{t−1}))
@@ -167,6 +200,58 @@ def run(
                     )
                 return {"x": x_new, "x_prev": x, "Wx_prev": Wx, "g": g,
                         "started": True}
+
+        elif config.algorithm == "admm":
+            # DLM (Ling-Shi-Wu-Ribeiro 2015), half-Laplacian matrix form.
+            # Edge-consensus ADMM (x_i = z_e = x_j per edge) with
+            # zero-initialized duals eliminates z to the edge midpoint; the
+            # aggregated node dual Φ (rows φ_i = Σ_{e∋i} λ_{e,i}) and a
+            # linearized f with proximal weight ρ give, with D = deg diag,
+            # A = adjacency, L⁺ = (D+A)/2 (signless half-Laplacian),
+            # L⁻ = (D−A)/2 (half-Laplacian):
+            #   X_{k+1} = (ρI + cD)⁻¹ (ρ X_k + c L⁺ X_k − ∇F(X_k) − Φ_k)
+            #   Φ_{k+1} = Φ_k + c L⁻ X_{k+1}
+            # The diagonal system solves row-wise; step size is the penalty
+            # pair (c, ρ), not η (constant by construction — the lr schedule
+            # is irrelevant here, as in the jax rule).
+            c_pen, rho = config.admm_c, config.admm_rho
+            D = np.diag(topo.degrees.astype(np.float64))
+            L_plus = 0.5 * (D + A)
+            L_minus = 0.5 * (D - A)
+            diag_inv = 1.0 / (rho + c_pen * topo.degrees)[:, None]
+            state = {"x": zeros.copy(), "phi": zeros.copy()}
+
+            def matrix_step(state, t, eta, grad_at):
+                x, phi = state["x"], state["phi"]
+                g = grad_at(x)
+                x_new = diag_inv * (
+                    rho * x + c_pen * (L_plus @ x) - g - phi
+                )
+                return {"x": x_new, "phi": phi + c_pen * (L_minus @ x_new)}
+
+        else:  # choco
+            # CHOCO-SGD (Koloskova et al. 2019, Algorithm 2 matrix form):
+            #   X_{t+½} = X_t − η ∇F(X_t)
+            #   X̂_{t+1} = X̂_t + Q(X_{t+½} − X̂_t)      ← the transmitted bits
+            #   X_{t+1} = X_{t+½} + γ (W − I) X̂_{t+1}
+            # Q = identity ('none') or per-row top-k; randomized compressors
+            # are rejected above.
+            gamma = config.choco_gamma
+            k_comp = config.compression_k
+            compress = (
+                (lambda v: v) if config.compression == "none"
+                else (lambda v: _topk_rows(v, k_comp))
+            )
+            state = {"x": zeros.copy(), "xhat": zeros.copy()}
+
+            def matrix_step(state, t, eta, grad_at):
+                x, xhat = state["x"], state["xhat"]
+                x_half = x - eta * grad_at(x)
+                xhat_new = xhat + compress(x_half - xhat)
+                return {
+                    "x": x_half + gamma * (W @ xhat_new - xhat_new),
+                    "xhat": xhat_new,
+                }
 
     else:
         matrix_step = None

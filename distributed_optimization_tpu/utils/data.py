@@ -147,24 +147,41 @@ def generate_digits_dataset(config) -> HostDataset:
     )
 
 
-def partition_summary(dataset: HostDataset) -> str:
+def partition_summary(dataset: HostDataset, max_workers: int = 32) -> str:
     """Per-worker shard report, parity with the reference's generation-time
     printout (reference ``utils.py:43-48``): shard size, target range, and
     mean per worker — the lines that make the sorted-partition non-IID skew
     visible — plus the dataset totals line.
+
+    Above ``max_workers`` workers the per-worker lines are truncated to the
+    first and last few plus an elision line (the reference prints all N, but
+    never runs past N=25; at this repo's sweep scales that would be thousands
+    of stderr lines per run).
     """
-    lines = []
-    for i in range(dataset.n_workers):
+
+    def worker_line(i: int) -> str:
         _, yi = dataset.shard(i)
         if len(yi) == 0:
             # n_workers > n_samples leaves trailing shards empty (array_split
             # semantics); runnable downstream, so report rather than crash.
-            lines.append(f"Worker {i}: 0 samples")
-            continue
-        lines.append(
+            return f"Worker {i}: 0 samples"
+        return (
             f"Worker {i}: {len(yi)} samples, Target y range: "
             f"[{yi.min():.2f}, {yi.max():.2f}], Mean y: {yi.mean():.2f}"
         )
+
+    n = dataset.n_workers
+    if n <= max_workers:
+        lines = [worker_line(i) for i in range(n)]
+    else:
+        head, tail = max_workers - 4, 2
+        sizes = np.array([len(idx) for idx in dataset.shard_indices])
+        lines = [worker_line(i) for i in range(head)]
+        lines.append(
+            f"... ({n - head - tail} workers elided; shard sizes "
+            f"{sizes.min()}-{sizes.max()}) ..."
+        )
+        lines.extend(worker_line(i) for i in range(n - tail, n))
     lines.append(
         f"Generated {dataset.X_full.shape[0]} samples, "
         f"{dataset.n_features} features"

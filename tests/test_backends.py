@@ -214,10 +214,17 @@ def test_record_consensus_off(quad_setup):
     assert np.isfinite(r.history.objective[-1])
 
 
-def test_numpy_backend_rejects_extended_algorithms(quad_setup):
+def test_numpy_backend_rejects_randomized_choco_compressors(quad_setup):
+    """All six algorithms run on the numpy oracle; the only carve-out is
+    CHOCO with a randomized compressor, whose draws live in the jax
+    counter-based PRNG stream an independent host oracle cannot reproduce."""
     cfg, ds, f_opt = quad_setup
-    with pytest.raises(ValueError, match="jax-backend capability"):
-        run_algorithm(cfg.replace(algorithm="admm", backend="numpy"), ds, f_opt)
+    with pytest.raises(ValueError, match="deterministic compressors"):
+        run_algorithm(
+            cfg.replace(algorithm="choco", backend="numpy",
+                        compression="qsgd", compression_k=4),
+            ds, f_opt,
+        )
 
 
 def test_sqrt_decay_matches_reference_schedule(quad_setup):

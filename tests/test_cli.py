@@ -120,19 +120,20 @@ def test_main_digits_dataset(tmp_path):
     assert blob["runs"][0]["history"]["objective"]
 
 
-def test_measure_time_flags(tmp_path):
+def test_measure_time_flags(tmp_path, capsys):
     """--measure-time / --no-measure-time round-trip: jax honors both; the
-    host simulators (always measured) reject the meaningless negative."""
-    import pytest
-
+    host simulators (always measured) warn on the meaningless negative and
+    run anyway (both directions are no-op-tolerant for cross-backend
+    scripts)."""
     from distributed_optimization_tpu.cli import main
 
     rc = main(_TINY + ["--measure-time", "--json", str(tmp_path / "a.json")])
     assert rc == 0
     rc = main(_TINY + ["--no-measure-time", "--json", str(tmp_path / "b.json")])
     assert rc == 0
-    with pytest.raises(SystemExit, match="always record measured"):
-        main(_TINY + ["--backend", "numpy", "--no-measure-time"])
+    rc = main(_TINY + ["--backend", "numpy", "--no-measure-time"])
+    assert rc == 0
+    assert "always" in capsys.readouterr().err
     # positive flag is a harmless no-op on the already-measured backends
     rc = main(_TINY + ["--backend", "numpy", "--measure-time"])
     assert rc == 0

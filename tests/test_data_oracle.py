@@ -126,3 +126,22 @@ def test_partition_summary_reports_every_worker():
     # must be non-decreasing.
     means = [float(ds.shard(i)[1].mean()) for i in range(cfg.n_workers)]
     assert means == sorted(means)
+
+
+def test_partition_summary_truncates_at_scale():
+    """Above max_workers the per-worker lines collapse to head + elision +
+    tail (sweep-scale runs would otherwise print thousands of stderr lines);
+    at or below the threshold every worker still gets its line."""
+    from distributed_optimization_tpu.utils.data import partition_summary
+
+    cfg = small_config("quadratic").replace(n_workers=100, n_samples=400)
+    ds = generate_synthetic_dataset(cfg)
+    text = partition_summary(ds)
+    lines = text.splitlines()
+    assert len(lines) < 40
+    assert lines[0].startswith("Worker 0:")
+    assert any("workers elided" in ln for ln in lines)
+    assert lines[-2].startswith("Worker 99:")
+    assert lines[-1].startswith("Generated 400 samples")
+    # Full report restored by raising the cap.
+    assert len(partition_summary(ds, max_workers=100).splitlines()) == 101

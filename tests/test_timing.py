@@ -106,12 +106,14 @@ def test_report_marks_interpolated_seconds(data):
 
 def test_coarse_cadence_auto_routes_to_chunked_loop(data, monkeypatch):
     """measure_timestamps=None (the default) routes coarse cadences with
-    enough per-chunk work (k >= COARSE_CADENCE_EVAL_EVERY and clamped
-    gradient-row volume k*N*b_eff >= COARSE_CADENCE_MIN_ROWS) through the
-    host-chunked loop — which outruns the fused nested scan there (PERF.md
-    §3 anomaly note) and reports measured timestamps. Small problems and
-    explicit False keep the fused scan. Thresholds are patched down so the
-    predicate is exercised with 60-iteration runs."""
+    enough per-chunk work (k >= COARSE_CADENCE_EVAL_EVERY and computed
+    gradient-row volume k*N*b >= COARSE_CADENCE_MIN_ROWS; the gather path
+    materializes static [N, b, d] batches, so b — not min(b, n_valid) — is
+    what the device computes) through the host-chunked loop — which outruns
+    the fused nested scan there (PERF.md §3 anomaly note) and reports
+    measured timestamps. Small problems and explicit False keep the fused
+    scan. Thresholds are patched down so the predicate is exercised with
+    60-iteration runs."""
     ds, f_opt = data
     monkeypatch.setattr(jax_backend, "COARSE_CADENCE_EVAL_EVERY", 20)
     # CFG is N=8, shards of 40 rows; b=8 → clamped volume 20*8*8 = 1280.
@@ -138,11 +140,12 @@ def test_coarse_cadence_auto_routes_to_chunked_loop(data, monkeypatch):
     np.testing.assert_allclose(
         res.final_models, fine.final_models, rtol=1e-6, atol=1e-8
     )
-    # The clamp: a huge configured batch on 40-row shards must not count as
-    # huge volume (b_eff = 40 ⇒ 20*8*40 = 6400 ≥ 1000 routes, but with the
-    # real 1e8 threshold restored it must NOT).
+    # A huge configured batch on 40-row shards COUNTS as huge volume: the
+    # gather tiles indices to the static batch shape, so the device really
+    # computes k*N*b = 20*8*3000 = 480k rows per chunk — routing to the
+    # chunked loop is the honest call.
     monkeypatch.setattr(jax_backend, "COARSE_CADENCE_MIN_ROWS", 10_000)
-    clamped = jax_backend.run(
-        cfg.replace(local_batch_size=10_000), ds, f_opt
+    big_batch = jax_backend.run(
+        cfg.replace(local_batch_size=3000), ds, f_opt
     )
-    assert not clamped.history.time_measured  # 6400 < 10_000 despite b=10k
+    assert big_batch.history.time_measured
