@@ -7,7 +7,10 @@ only the block-boundary rows with the neighboring devices via
 connected / centralized). This replaces the reference's simulated dense
 ``W @ models`` matmul (reference ``trainer.py:173``) with the real collective
 traffic pattern: a ring of N workers on D devices moves exactly 2·d floats
-per device per round over ICI, independent of N.
+per device per round over ICI, independent of N — enforced against the
+compiled HLO (instruction kinds and payload element counts) by
+``tests/test_collectives.py::test_ring_lowers_to_boundary_permutes_with_2d_floats``
+and companions, for both this module's explicit ops and the GSPMD stencils.
 
 The GSPMD stencils in ``ops/mixing.py`` compile to the same collectives
 automatically; this module is the manually scheduled form — used when
