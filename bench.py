@@ -1,5 +1,15 @@
 """Headline benchmark: the BASELINE.json north-star configuration.
 
+Protocol (round 3 — VERDICT r2 item 1): the shared tunneled chip swings
+2-3x with co-tenant load, so the jax headline and the CPU baseline are
+measured INTERLEAVED — five cycles, each one numpy-simulator segment
+followed by one full jax run — and the reported value is the MEDIAN of the
+five jax measurements over the MEDIAN of the five numpy measurements, with
+the spreads printed alongside. Sequential best-of-N (the round-1/2
+protocol) let the two sides sample different chip/host windows and made the
+ratio the product of two noisy extremes; medians of interleaved samples
+gate out exactly that.
+
 Two measurements, one JSON line:
 
 1. **Parity check** (stderr): the reference study's flagship decentralized
@@ -10,9 +20,12 @@ Two measurements, one JSON line:
 
 2. **Headline** (stdout JSON): the north-star scale config named in
    BASELINE.json — 256-worker decentralized logistic regression on a ring —
-   JAX/TPU backend iterations/second vs the CPU reference-semantics simulator
-   measured on this same machine (the reference publishes no wall-clock
-   numbers — BASELINE.md; the stated target is ≥50× the CPU simulator).
+   at T=30,000, a horizon the run actually CROSSES the study's ε ≤ 0.08
+   threshold within (measured crossing ≈ iteration 25k,
+   docs/perf/northstar_consensus.json; the round-2 T=10k headline ended at
+   gap 0.113 > ε, which made "throughput of a converging run" an
+   extrapolation). Gates: finite metrics, the ε-crossing itself, and
+   bounded consensus.
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": ..., "unit": "iters/sec", "vs_baseline": ...}
@@ -21,11 +34,14 @@ Prints exactly ONE JSON line on stdout:
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
 
 
 def main() -> None:
+    import numpy as np
+
     from distributed_optimization_tpu.backends import jax_backend, numpy_backend
     from distributed_optimization_tpu.config import ExperimentConfig
     from distributed_optimization_tpu.metrics import iterations_to_threshold
@@ -59,54 +75,71 @@ def main() -> None:
         )
 
     # --- 2. north-star scale config: N=256 decentralized logistic ---
-    cfg = parity_cfg.replace(n_workers=256)
+    # T=30k crosses the study's ε ≤ 0.08 within the horizon (≈ iter 25k).
+    cfg = parity_cfg.replace(n_workers=256, n_iterations=30_000)
     ds = generate_synthetic_dataset(cfg)
     _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
 
-    base_iters = 200
-    base = numpy_backend.run(cfg.replace(n_iterations=base_iters), ds, f_opt)
-    baseline_ips = base.history.iters_per_second
-    print(
-        f"[bench] N=256 numpy reference-semantics simulator: "
-        f"{baseline_ips:.1f} iters/sec",
-        file=sys.stderr,
-    )
+    # Interleaved median-of-5: numpy segment, then jax run, x5. The numpy
+    # simulator is steady-state (same per-iteration work every iteration),
+    # so a 400-iteration segment per cycle samples its rate honestly; the
+    # jax run is the full T=30k workload. One warmup jax run first so the
+    # XLA compile (~20-40 s) is paid outside the measured cycles — its
+    # metrics drive the convergence gates below.
+    CYCLES = 5
+    BASE_SEGMENT_ITERS = 400
+    warm = jax_backend.run(cfg, ds, f_opt)
+    hist = warm.history
 
-    # The shared-tunnel chip's throughput varies 2-3x with co-tenant load;
-    # report the best of three back-to-back runs to reduce that noise (the
-    # convergence gates below use the first run's metrics). Identical
-    # workload each time (metrics on) so max() filters only noise.
-    result = jax_backend.run(cfg, ds, f_opt)
-    hist = result.history
-    reps = [float(hist.iters_per_second)]
-    for _ in range(2):
-        reps.append(float(jax_backend.run(cfg, ds, f_opt).history.iters_per_second))
-    jax_ips = max(reps)
+    base_cfg = cfg.replace(n_iterations=BASE_SEGMENT_ITERS)
+    numpy_ips: list[float] = []
+    jax_ips: list[float] = []
+    for cycle in range(CYCLES):
+        b = numpy_backend.run(base_cfg, ds, f_opt)
+        numpy_ips.append(float(b.history.iters_per_second))
+        r = jax_backend.run(cfg, ds, f_opt, measure_compile=False)
+        jax_ips.append(float(r.history.iters_per_second))
+        print(
+            f"[bench] cycle {cycle + 1}/{CYCLES}: numpy "
+            f"{numpy_ips[-1]:.1f}, jax {jax_ips[-1]:.0f} iters/sec",
+            file=sys.stderr,
+        )
+
+    jax_median = statistics.median(jax_ips)
+    numpy_median = statistics.median(numpy_ips)
     print(
-        f"[bench] N=256 jax backend: {jax_ips:.0f} iters/sec best-of-3 "
-        f"({'/'.join(f'{r:.0f}' for r in reps)}; "
-        f"compile {hist.compile_seconds:.1f}s, final gap "
-        f"{hist.objective[-1]:.4f}, consensus {hist.consensus_error[-1]:.2e})",
+        f"[bench] N=256 T=30k jax: median {jax_median:.0f} iters/sec "
+        f"(spread {min(jax_ips):.0f}-{max(jax_ips):.0f}); numpy "
+        f"reference-semantics: median {numpy_median:.1f} "
+        f"(spread {min(numpy_ips):.1f}-{max(numpy_ips):.1f}); compile "
+        f"{hist.compile_seconds:.1f}s, final gap {hist.objective[-1]:.4f}, "
+        f"consensus {hist.consensus_error[-1]:.2e}",
         file=sys.stderr,
     )
-    import numpy as np
 
     if not np.all(np.isfinite(hist.objective)):
         raise SystemExit("north-star run produced non-finite metrics")
-    # Convergence gates on the headline run itself. The N=256 ring cannot
-    # reach 1e-4 consensus in 10k iters — its spectral gap (2.0e-4) puts the
-    # crossing at ~3e7 iterations, and at this horizon consensus is still in
-    # its transient GROWTH phase (~4e-3 → ~0.4, peaking before the ~1/t decay
-    # sets in; measured in docs/perf/scaling.json). The literal north-star
-    # crossing with measured wall-clock is demonstrated on the N=256 grid by
-    # examples/northstar_consensus.py → docs/perf/northstar_consensus.json.
-    # Here: the gap must halve (real optimization) and consensus must stay
-    # bounded (gossip contraction active, not diverging).
-    if not (hist.objective[-1] < 0.5 * hist.objective[0]):
+    # The run must cross the study's own suboptimality threshold within its
+    # horizon — the headline is the throughput of a run that actually
+    # converges to ε, not of a truncated transient.
+    crossed = iterations_to_threshold(
+        hist.objective, cfg.suboptimality_threshold, hist.eval_iterations
+    )
+    if not (0 < crossed <= cfg.n_iterations):
         raise SystemExit(
-            "north-star run is not optimizing — refusing to report "
-            f"throughput (gap {hist.objective[0]:.4f} -> {hist.objective[-1]:.4f})"
+            f"north-star run never reached ε ≤ {cfg.suboptimality_threshold} "
+            f"within T={cfg.n_iterations} (final gap {hist.objective[-1]:.4f})"
+            " — refusing to report throughput"
         )
+    print(
+        f"[bench] north-star ε-crossing at iteration {crossed} "
+        f"(threshold {cfg.suboptimality_threshold})",
+        file=sys.stderr,
+    )
+    # Consensus must stay bounded (gossip contraction active). The N=256
+    # ring's consensus is still in its slow ~1/t phase at T=30k (spectral
+    # gap 2e-4); boundedness, not a small absolute value, is the honest
+    # gate here (see docs/PERF.md §2 for the full consensus story).
     cons = hist.consensus_error
     if not (np.all(np.isfinite(cons)) and cons[-1] < 1.0):
         raise SystemExit(
@@ -117,10 +150,10 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "dsgd_ring_logistic_N256_T10k_iters_per_sec",
-                "value": round(jax_ips, 2),
+                "metric": "dsgd_ring_logistic_N256_T30k_iters_per_sec_median5",
+                "value": round(jax_median, 2),
                 "unit": "iters/sec",
-                "vs_baseline": round(jax_ips / baseline_ips, 2),
+                "vs_baseline": round(jax_median / numpy_median, 2),
             }
         )
     )
