@@ -56,11 +56,6 @@ from distributed_optimization_tpu.parallel.mesh import (
 from distributed_optimization_tpu.utils.data import HostDataset, stack_shards
 
 
-# Auto-routing thresholds for coarse eval cadences (see the routing comment
-# in ``_run``; module-level so tests can exercise the predicate cheaply).
-COARSE_CADENCE_EVAL_EVERY = 50_000
-COARSE_CADENCE_MIN_ROWS = 100_000_000  # per-chunk gradient rows actually computed
-
 # Forcing --sampling-impl dense beyond this padded shard length warns: the
 # [L, L] ranking matrix is quadratic and the measured crossover to gather is
 # ~L=250 (docs/perf/breakdown.json). Single source for the backend warning
@@ -240,9 +235,12 @@ def run(
     ``eval_every`` iterations) instead of the fully fused scan; the returned
     history then carries measured wall-clock (``time_measured=True``) rather
     than a linspace interpolation of the total run time. The default
-    ``None`` resolves automatically: coarse cadences with enough per-chunk
-    work route to the chunked loop (faster there AND measured — see the
-    routing rule in ``_run``); pass ``False`` to force the fused scan.
+    (``None`` == ``False``) is the fused scan at every cadence: since the
+    round-3 flat restructuring fixed the nested-loop pipelining defect, the
+    fused path is the fastest at EVERY eval cadence (measured 2.2× the
+    chunked loop at eval_every=50k — docs/PERF.md "root cause" section), so
+    the former coarse-cadence auto-routing is gone; measured timestamps are
+    purely opt-in.
 
     A float64 config runs under a scoped ``enable_x64`` — without it jax
     silently truncates every array to float32, defeating the fidelity dtype.
@@ -466,10 +464,9 @@ def _run(
         collect_metrics and algo.is_decentralized and config.record_consensus
     )
     eval_every = config.eval_every
-    # Split the unroll budget between the two nested scans so the total
-    # unrolled step bodies stay ~scan_unroll (not scan_unroll²): the inner
-    # per-chunk scan takes up to the full budget, the outer chunk scan only
-    # what remains after the inner loop is already unrolled.
+    # The chunked (host-driven) path nests a scan per chunk; split the unroll
+    # budget so the total unrolled step bodies stay ~scan_unroll (not
+    # scan_unroll²). The fused path below does NOT nest — see _flat_micro.
     scan_unroll = config.resolved_scan_unroll(jax.devices()[0].platform)
     inner_unroll = min(scan_unroll, eval_every)
     outer_unroll = max(1, scan_unroll // eval_every)
@@ -490,8 +487,8 @@ def _run(
 
         fused_mix_step = fused_ring_dsgd_step
 
-    def make_chunk(data):
-        """Bind the step/chunk closures to the data pytree passed through jit."""
+    def make_step_eval(data):
+        """Bind the step/eval closures to the data pytree passed through jit."""
         X, y, n_valid = data["X"], data["y"], data["n_valid"]
         schedule = data.get("schedule")
 
@@ -560,12 +557,7 @@ def _run(
                 )
             return new_state, None
 
-        def chunk(state, ts):
-            # ``eval_every`` iterations of pure optimization, then one
-            # on-device metric evaluation — the eval-cadence knob SURVEY.md §7
-            # hard part (b) calls for (the reference evaluates every
-            # iteration; k=1 reproduces that exactly).
-            state, _ = jax.lax.scan(step, state, ts, unroll=inner_unroll)
+        def eval_metrics(state):
             out = {}
             if collect_metrics:
                 x = state["x"]
@@ -575,52 +567,88 @@ def _run(
                     out["cons"] = jnp.mean(
                         jnp.sum((x - xbar[None, :]) ** 2, axis=1)
                     )
+            return out
+
+        def floats_for(ts):
+            # Honest comms accounting under faults: floats actually
+            # exchanged over realized edges for these iterations (recomputed
+            # from the fault keys, so it costs one tiny mask redraw per
+            # iteration, no extra communication).
+            return (
+                jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts))
+                * edge_payload
+            )
+
+        return step, eval_metrics, floats_for
+
+    def make_chunk(data):
+        """One eval-chunk for the host-driven loop: ``eval_every`` iterations
+        of pure optimization under a nested scan, then one on-device metric
+        evaluation — the eval-cadence knob SURVEY.md §7 hard part (b) calls
+        for (the reference evaluates every iteration; k=1 reproduces that
+        exactly)."""
+        step, eval_metrics, floats_for = make_step_eval(data)
+
+        def chunk(state, ts):
+            state, _ = jax.lax.scan(step, state, ts, unroll=inner_unroll)
+            out = eval_metrics(state)
             if faulty is not None:
-                # Honest comms accounting under faults: floats actually
-                # exchanged over realized edges this chunk (recomputed from
-                # the fault keys, so it costs one tiny mask redraw per
-                # iteration, no extra communication).
-                out["floats"] = (
-                    jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts))
-                    * edge_payload
-                )
+                out["floats"] = floats_for(ts)
             return state, out
 
         return chunk
 
     n_evals = T // eval_every
 
-    # measure_timestamps=None (the default) resolves automatically: very
-    # coarse eval cadences run FASTER under the host-driven chunk loop than
-    # under the fused nested scan (the fused path dips ~2-3x at k>=100 —
-    # docs/PERF.md §3 anomaly note — while the chunked loop measured 125k
-    # iters/sec at k=100k on the 40M-iteration ring run), provided each
-    # chunk computes long enough to amortize its ~0.3s host sync. The
-    # per-chunk gradient-row volume k·rows >= 1e8 marks the benchmarked
-    # scale (~2e8 at the N=256 headline with k=50k); small problems keep the
-    # fused scan. ``rows`` counts rows the device actually COMPUTES per
-    # iteration under the resolved sampling impl: the dense-weights path
-    # touches every padded shard row (N·L), and the gather path materializes
-    # a static [N, b, d] batch — indices are tiled up to batch_size
-    # (ops/sampling.py jnp.resize), so padded/tiled rows are real FLOPs even
-    # though they carry zero weight; no n_valid clamp applies. Explicit
-    # True/False always wins — False is the only way to measure the fused
-    # path at coarse cadence (e.g. to regenerate the anomaly data).
+    # The default is the fused scan at every cadence (see ``run``'s
+    # docstring: the flat restructuring removed the coarse-cadence defect
+    # that round 2's auto-routing worked around); measured timestamps are
+    # opt-in because the host-driven loop pays one tunnel round-trip per
+    # eval chunk and measured 2.2× slower at coarse cadence.
     if measure_timestamps is None:
-        if sampling_impl == "dense":
-            rows_per_iter = n * device_data.X.shape[1]
-        else:
-            rows_per_iter = n * config.local_batch_size
-        measure_timestamps = (
-            eval_every >= COARSE_CADENCE_EVAL_EVERY
-            and eval_every * rows_per_iter >= COARSE_CADENCE_MIN_ROWS
-        )
+        measure_timestamps = False
 
     if checkpoint is None and not measure_timestamps:
+        # FLAT fused scan (round-3 anomaly fix — mechanism and measurements
+        # in docs/PERF.md §"root cause"): the run is ONE scan over
+        # micro-chunks of ``micro`` Python-unrolled steps with the metric
+        # eval computed INLINE every trip — never a scan nested inside a
+        # scan, and no lax.cond in the body. Both alternatives measured
+        # badly on the chip, for the same reason: non-flat control flow in
+        # the hot loop body defeats XLA:TPU's inter-iteration pipelining.
+        # The round-2 nested form (outer chunks × inner step scan) ran
+        # identical fusions ~6.4× slower per execution inside the nested
+        # while (device-trace evidence, co-tenant-free; 2.1× total device
+        # time), and a cond-guarded eval re-serialized the loop harder
+        # still (~23k vs ~47k iters/sec, same session). Computing the eval
+        # every trip is measured-free at this scale (the full-data pass is
+        # a few µs against a latency-bound step) and the off-cadence rows
+        # are discarded host-side; ``micro`` is the largest divisor of
+        # eval_every within the unroll budget so some trip lands exactly on
+        # every eval boundary. At k=1 this degenerates to exactly the old
+        # (always-fast) flat structure.
+        micro = next(
+            d for d in range(min(scan_unroll, eval_every), 0, -1)
+            if eval_every % d == 0
+        )
+        trips_per_eval = eval_every // micro
+        n_trips = T // micro
+        flat_unroll = max(1, scan_unroll // micro)
+
         def run_scan(state_init, data):
-            ts = jnp.arange(T, dtype=jnp.int32).reshape(n_evals, eval_every)
+            step, eval_metrics, floats_for = make_step_eval(data)
+
+            def microchunk(state, ts_row):
+                for j in range(micro):
+                    state, _ = step(state, ts_row[j])
+                out = eval_metrics(state) if collect_metrics else {}
+                if faulty is not None:
+                    out["floats"] = floats_for(ts_row)
+                return state, out
+
+            ts = jnp.arange(T, dtype=jnp.int32).reshape(n_trips, micro)
             return jax.lax.scan(
-                make_chunk(data), state_init, ts, unroll=outer_unroll
+                microchunk, state_init, ts, unroll=flat_unroll
             )
 
         # AOT compile so compile time and steady-state execution are separable
@@ -636,12 +664,15 @@ def _run(
         run_seconds = time.perf_counter() - t1
         executed_iters = T
 
+        # Keep only the rows on the eval cadence (the cond filler is zeros).
+        sel = slice(trips_per_eval - 1, None, trips_per_eval)
         gap_hist = (
-            np.asarray(ys["gap"], dtype=np.float64)
+            np.asarray(ys["gap"][sel], dtype=np.float64)
             if "gap" in ys else np.full(n_evals, np.nan)
         )
         cons_hist = (
-            np.asarray(ys["cons"], dtype=np.float64) if "cons" in ys else None
+            np.asarray(ys["cons"][sel], dtype=np.float64)
+            if "cons" in ys else None
         )
         realized_floats = (
             float(np.sum(np.asarray(ys["floats"], dtype=np.float64)))

@@ -53,9 +53,15 @@ PRESETS: dict[str, dict] = {
     "gt-torus-64": dict(problem_type="quadratic", algorithm="gradient_tracking",
                         topology="grid", n_workers=64,
                         learning_rate_eta0=0.01),
-    # 5. Decentralized logistic on real image features, 256 workers (stretch)
-    "digits-256": dict(problem_type="logistic", algorithm="dsgd",
-                       topology="ring", n_workers=256, dataset="digits"),
+    # 5. Decentralized logistic on real image features (stretch). The only
+    # offline real image dataset in this environment is sklearn's bundled
+    # 8x8 digits (1,797 samples), which supports ~28 samples/worker at
+    # N=64; the BASELINE "256 workers" scale is demonstrated on the
+    # synthetic config (12,500 samples — bench.py's headline), because 256
+    # workers over 1,797 real samples would be 7 samples/worker — runnable
+    # but statistically degenerate. docs/perf/presets.json measures both.
+    "digits-64": dict(problem_type="logistic", algorithm="dsgd",
+                      topology="ring", n_workers=64, dataset="digits"),
 }
 
 
@@ -196,10 +202,11 @@ def build_parser() -> argparse.ArgumentParser:
                       help="record real per-eval wall-clock timestamps "
                            "(host-driven chunk loop; one sync per eval) "
                            "instead of interpolating the fused scan's total "
-                           "(jax backend). Default: automatic — coarse eval "
-                           "cadences with enough per-chunk work route to the "
-                           "measured chunk loop; --no-measure-time forces "
-                           "the fused scan")
+                           "(jax backend). Default: off — the fused flat "
+                           "scan is the fastest path at every eval cadence "
+                           "(docs/PERF.md root-cause section); opt in when "
+                           "measured per-eval wall-clock matters more than "
+                           "throughput")
     diag.add_argument("--profile-dir", metavar="DIR", default=None,
                       help="collect a jax.profiler (XProf/TensorBoard) trace "
                            "of the run into DIR")

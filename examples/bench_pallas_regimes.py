@@ -1,0 +1,151 @@
+"""Settle the pallas mixing tier with data (VERDICT r2 item 8).
+
+Post-dense-sampling, pallas and the XLA roll-stencil tied within chip noise
+at the headline shape (d=81, f32 — docs/perf/mixing_bench.json), leaving
+``auto``'s pallas pick justified only by a gather-era measurement. This
+script measures the regimes where a hand-fused VMEM kernel could plausibly
+pull ahead — larger model dimension (more bytes per gossip round) and
+bfloat16 (half the bytes, VPU-friendly) — at both the op level and end to
+end, all variants interleaved round-robin per cycle so co-tenant swings hit
+every cell comparably.
+
+Matrix: {stencil, pallas} × d ∈ {81, 1024} × {float32, bfloat16}.
+Writes ``docs/perf/pallas_regimes.json``; whatever wins is what
+``mixing_impl='auto'`` must encode (jax_backend._resolve_auto_mixing_impl).
+
+Usage:  python examples/bench_pallas_regimes.py [--iters 10000] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _time_op(fn, x, k: int, repeats: int) -> float:
+    @jax.jit
+    def chained(x0):
+        return jax.lax.scan(lambda c, _: (fn(c), None), x0, None, length=k)[0]
+
+    chained(x).block_until_ready()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        chained(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10_000)
+    ap.add_argument("--n-workers", type=int, default=256)
+    ap.add_argument("--op-chain", type=int, default=2000)
+    ap.add_argument("--cycles", type=int, default=3)
+    ap.add_argument("--out", default="docs/perf/pallas_regimes.json")
+    args = ap.parse_args()
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.ops.mixing import make_mixing_op
+    from distributed_optimization_tpu.parallel.topology import build_topology
+    from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+    from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+    dev = jax.devices()[0]
+    n = args.n_workers
+    topo = build_topology("ring", n)
+    print(f"[pallas_regimes] device={dev} N={n}", file=sys.stderr)
+
+    # --- 1. op level: W x across d × dtype --------------------------------
+    op_rows = {}
+    rng = np.random.default_rng(0)
+    for d in (81, 1024):
+        for dt in ("float32", "bfloat16"):
+            x = jnp.asarray(rng.standard_normal((n, d)), dtype=dt)
+            for impl in ("stencil", "pallas"):
+                fn = make_mixing_op(topo, impl=impl, dtype=x.dtype).apply
+                sec = _time_op(fn, x, args.op_chain, repeats=3)
+                key = f"d{d}_{dt}_{impl}"
+                op_rows[key] = round(sec / args.op_chain * 1e6, 3)
+                print(f"[pallas_regimes] op {key:26s} "
+                      f"{op_rows[key]:8.3f} us/apply", file=sys.stderr)
+
+    # --- 2. end to end: full runs across d × dtype ------------------------
+    variants = {}
+    for d in (81, 1024):
+        for dt in ("float32", "bfloat16"):
+            cfg = ExperimentConfig(
+                problem_type="logistic", algorithm="dsgd", topology="ring",
+                n_workers=n, n_iterations=args.iters,
+                n_features=d - 1, n_informative_features=min(60, d - 21),
+                dtype=dt,
+            )
+            for impl in ("stencil", "pallas"):
+                variants[f"d{d}_{dt}_{impl}"] = (cfg.replace(mixing_impl=impl))
+
+    # One dataset per distinct feature count (generation depends on d).
+    data_cache = {}
+    for name, cfg in variants.items():
+        if cfg.n_features not in data_cache:
+            ds = generate_synthetic_dataset(cfg)
+            _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+            data_cache[cfg.n_features] = (ds, f_opt)
+
+    runs: dict[str, list[float]] = {name: [] for name in variants}
+    for c in range(args.cycles):
+        for name, cfg in variants.items():
+            ds, f_opt = data_cache[cfg.n_features]
+            r = jax_backend.run(cfg, ds, f_opt)
+            runs[name].append(float(r.history.iters_per_second))
+    e2e = {}
+    for name, vals in runs.items():
+        e2e[name] = {
+            "iters_per_sec_median": round(statistics.median(vals), 1),
+            "runs": [round(v) for v in vals],
+        }
+        print(f"[pallas_regimes] e2e {name:26s} median "
+              f"{e2e[name]['iters_per_sec_median']:9.0f}", file=sys.stderr)
+
+    # Per-regime verdict: does pallas beat stencil outside noise (>10%)?
+    verdicts = {}
+    for d in (81, 1024):
+        for dt in ("float32", "bfloat16"):
+            s = e2e[f"d{d}_{dt}_stencil"]["iters_per_sec_median"]
+            p = e2e[f"d{d}_{dt}_pallas"]["iters_per_sec_median"]
+            verdicts[f"d{d}_{dt}"] = {
+                "stencil": s, "pallas": p,
+                "pallas_over_stencil": round(p / s, 3),
+                "pallas_wins_outside_noise": p > 1.10 * s,
+            }
+    out = {
+        "device": str(dev), "n_workers": n, "iters": args.iters,
+        "cycles": args.cycles,
+        "op_us_per_apply": op_rows,
+        "end_to_end": e2e,
+        "verdicts": verdicts,
+        "note": "interleaved round-robin per cycle; medians reported. The "
+                "'auto' mixing rule must match these verdicts "
+                "(jax_backend._resolve_auto_mixing_impl).",
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps({"metric": "pallas_regimes",
+                      "value": {k: v["pallas_wins_outside_noise"]
+                                for k, v in verdicts.items()}}))
+
+
+if __name__ == "__main__":
+    main()

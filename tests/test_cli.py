@@ -64,7 +64,7 @@ def test_presets_cover_baseline_configs(tmp_path):
 
     assert set(PRESETS) == {
         "quadratic-fc-4", "logistic-ring-8", "admm-er-16", "gt-torus-64",
-        "digits-256",
+        "digits-64",
     }
     # Preset end-to-end (tiny horizon), with an explicit flag overriding it.
     json_out = tmp_path / "p.json"

@@ -104,33 +104,22 @@ def test_report_marks_interpolated_seconds(data):
     assert "interpolated" not in text
 
 
-def test_coarse_cadence_auto_routes_to_chunked_loop(data, monkeypatch):
-    """measure_timestamps=None (the default) routes coarse cadences with
-    enough per-chunk work (k >= COARSE_CADENCE_EVAL_EVERY and computed
-    gradient-row volume k*N*b >= COARSE_CADENCE_MIN_ROWS; the gather path
-    materializes static [N, b, d] batches, so b — not min(b, n_valid) — is
-    what the device computes) through the host-chunked loop — which outruns
-    the fused nested scan there (PERF.md §3 anomaly note) and reports
-    measured timestamps. Small problems and explicit False keep the fused
-    scan. Thresholds are patched down so the predicate is exercised with
-    60-iteration runs."""
+def test_default_is_fused_at_every_cadence(data):
+    """measure_timestamps defaults to the fused flat scan at EVERY eval
+    cadence (the round-2 coarse-cadence auto-routing to the chunked loop is
+    gone — the flat restructuring removed the nested-while pipelining
+    defect it worked around, and the fused path now measures faster than
+    the chunked loop everywhere; docs/PERF.md root-cause section). Measured
+    timestamps are opt-in, and cadence choices never change the trajectory
+    at shared eval points."""
     ds, f_opt = data
-    monkeypatch.setattr(jax_backend, "COARSE_CADENCE_EVAL_EVERY", 20)
-    # CFG is N=8, shards of 40 rows; b=8 → clamped volume 20*8*8 = 1280.
-    monkeypatch.setattr(jax_backend, "COARSE_CADENCE_MIN_ROWS", 1000)
     cfg = CFG.replace(n_iterations=60, eval_every=20, local_batch_size=8)
     res = jax_backend.run(cfg, ds, f_opt)
-    assert res.history.time_measured  # chunked path engaged automatically
+    assert not res.history.time_measured  # fused by default, coarse cadence
     assert res.history.objective.shape == (3,)
-    # Explicit False forces the fused scan (the only way to measure it at
-    # coarse cadence).
-    forced = jax_backend.run(cfg, ds, f_opt, measure_timestamps=False)
-    assert not forced.history.time_measured
-    # Below the volume threshold (b=1 → 160 rows/chunk): fused by default.
-    small = jax_backend.run(cfg.replace(local_batch_size=1), ds, f_opt)
-    assert not small.history.time_measured
-    # Below the cadence threshold: fused by default; same trajectory at the
-    # shared eval points.
+    opt_in = jax_backend.run(cfg, ds, f_opt, measure_timestamps=True)
+    assert opt_in.history.time_measured
+    # Different cadences: same trajectory at the shared eval points.
     fine = jax_backend.run(cfg.replace(eval_every=10), ds, f_opt)
     assert not fine.history.time_measured
     np.testing.assert_allclose(
@@ -140,12 +129,10 @@ def test_coarse_cadence_auto_routes_to_chunked_loop(data, monkeypatch):
     np.testing.assert_allclose(
         res.final_models, fine.final_models, rtol=1e-6, atol=1e-8
     )
-    # A huge configured batch on 40-row shards COUNTS as huge volume: the
-    # gather tiles indices to the static batch shape, so the device really
-    # computes k*N*b = 20*8*3000 = 480k rows per chunk — routing to the
-    # chunked loop is the honest call.
-    monkeypatch.setattr(jax_backend, "COARSE_CADENCE_MIN_ROWS", 10_000)
-    big_batch = jax_backend.run(
-        cfg.replace(local_batch_size=3000), ds, f_opt
+    # Cadences that don't divide by the unroll budget (prime k) still land
+    # every eval exactly on its boundary via the micro-chunk divisor.
+    prime = jax_backend.run(
+        cfg.replace(n_iterations=63, eval_every=7, scan_unroll=4), ds, f_opt
     )
-    assert big_batch.history.time_measured
+    assert prime.history.objective.shape == (9,)
+    assert np.all(np.isfinite(prime.history.objective))
