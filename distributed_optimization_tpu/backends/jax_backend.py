@@ -259,21 +259,32 @@ def run(
         )
 
 
+# Model dimension above which `auto` picks the pallas ring kernel on a
+# single TPU chip (float32 only — Mosaic's dynamic_rotate is 32-bit-only, so
+# the kernel cannot even compile for bfloat16). Measured round 3
+# (docs/perf/pallas_regimes.json, interleaved medians): at the headline
+# d=81 the XLA stencil wins (60.8k vs 55.1k iters/sec e2e; 0.016 vs 0.022
+# µs/apply), at d=1024 pallas wins (17.9k vs 15.8k e2e; 0.016 vs 0.024
+# µs/apply) — the hand-fused VMEM pass pays off once the row is wide enough
+# to amortize the kernel launch. 512 is the midpoint of the measured
+# bracket, not a measured crossover.
+PALLAS_MIN_DIM = 512
+
+
 def _resolve_auto_mixing_impl(config, topo, algo, mesh, platform: str) -> str:
     """Resolve ``mixing_impl='auto'`` from measured data.
 
-    On a single real TPU chip the hand-fused pallas ring kernel (one VMEM pass
-    for W x − ηg) measured fastest end-to-end in the gather-sampling era
-    (5,080 vs 4,184 iters/sec for the XLA roll-stencil at N=256); after the
-    dense-sampling change removed the mixing bottleneck, pallas and stencil
-    tie within chip noise (46.2k vs 47.6k interleaved at T=10k —
-    ``docs/perf/mixing_bench.json``), so the pallas pick is kept for the
-    envelope where it never measured worse: TPU, no multi-device mesh (a
-    pallas_call is an opaque custom call GSPMD cannot partition), ring with
-    the fused-step consumer (dsgd), static synchronous topology (the fault
-    machinery bypasses the mixing op anyway), float32. Everything else keeps
-    the round-1 rule: stencil where the graph embeds as mesh shifts, dense
-    for irregular graphs (``ops/mixing.py``).
+    Round-1 (gather era): the fused pallas ring kernel won decisively at the
+    headline shape. Round-2 (dense sampling): pallas and stencil tied within
+    chip noise. Round-3 (flat fused scan): the stencil is ~10% AHEAD at
+    d=81 while pallas wins ~13% at d=1024 (``docs/perf/pallas_regimes.json``),
+    so the pallas pick now requires a wide model dimension on top of the
+    envelope conditions: TPU, no multi-device mesh (a pallas_call is an
+    opaque custom call GSPMD cannot partition), ring with the fused-step
+    consumer (dsgd), static synchronous topology (the fault machinery
+    bypasses the mixing op anyway), float32 (Mosaic rotate cannot compile
+    bf16). Everything else keeps the round-1 rule: stencil where the graph
+    embeds as mesh shifts, dense for irregular graphs (``ops/mixing.py``).
     """
     if config.mixing_impl != "auto":
         return config.mixing_impl
@@ -290,6 +301,7 @@ def _resolve_auto_mixing_impl(config, topo, algo, mesh, platform: str) -> str:
         and topo.n >= 3
         and static_sync
         and config.dtype == "float32"
+        and config.n_features + 1 >= PALLAS_MIN_DIM
     ):
         return "pallas"
     return "auto"  # make_mixing_op resolves: stencil if supported, else dense

@@ -69,19 +69,32 @@ def test_auto_picks_stencil_for_regular_graphs():
 
 
 def test_auto_impl_resolution_uses_measured_tpu_winner():
-    """auto -> pallas exactly where examples/bench_mixing.py measured the win:
-    single-chip TPU, dsgd on a static synchronous ring, float32."""
+    """auto -> pallas exactly where examples/bench_pallas_regimes.py measured
+    the win: single-chip TPU, dsgd on a static synchronous ring, float32,
+    AND a wide model dimension (d >= PALLAS_MIN_DIM — at the headline d=81
+    the XLA stencil measured ahead in round 3)."""
     from distributed_optimization_tpu.algorithms import get_algorithm
     from distributed_optimization_tpu.backends.jax_backend import (
+        PALLAS_MIN_DIM,
         _resolve_auto_mixing_impl,
     )
     from distributed_optimization_tpu.config import ExperimentConfig
 
-    cfg = ExperimentConfig(algorithm="dsgd", topology="ring", n_workers=8)
+    wide = PALLAS_MIN_DIM + 63
+    cfg = ExperimentConfig(algorithm="dsgd", topology="ring", n_workers=8,
+                           n_features=wide, n_informative_features=8)
     topo = build_topology("ring", 8)
     dsgd = get_algorithm("dsgd")
 
     assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "tpu") == "pallas"
+    # The headline shape (d=81): stencil measured ahead post-flat-scan.
+    assert (
+        _resolve_auto_mixing_impl(
+            cfg.replace(n_features=80, n_informative_features=60),
+            topo, dsgd, None, "tpu",
+        )
+        == "auto"
+    )
     # Outside the measured envelope: fall through to the stencil/dense rule.
     assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "cpu") == "auto"
     assert _resolve_auto_mixing_impl(cfg, topo, dsgd, object(), "tpu") == "auto"
