@@ -2,10 +2,10 @@
 
 The framework's native runtime tier for host-side execution: the reference's
 two algorithms (centralized SGD and D-SGD with a dense mixing matrix —
-reference ``trainer.py:7-74``/``76-197``) plus matrix-form recursions of the
-exact first-order extensions (DIGing gradient tracking, EXTRA — the same
-recursions the numpy oracle implements, giving a third independent
-implementation for cross-tier verification), compiled from
+reference ``trainer.py:7-74``/``76-197``) plus matrix/node-form recursions
+of the exact methods (DIGing gradient tracking, EXTRA, and DLM decentralized
+ADMM — the same recursions the numpy oracle implements, giving a third
+independent implementation for cross-tier verification), compiled from
 ``native/src/gossip_core.cpp`` into a shared library (OpenMP-parallel
 worker loop, stable closed-form objectives). Fidelity-sensitive work stays on
 the numpy oracle (exact reference semantics, injectable batches); this tier
@@ -36,8 +36,9 @@ from distributed_optimization_tpu.metrics import (
 from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.utils.data import HostDataset
 
-_SUPPORTED = ("centralized", "dsgd", "gradient_tracking", "extra")
-_ALGO_CODES = {"centralized": 0, "dsgd": 1, "gradient_tracking": 2, "extra": 3}
+_SUPPORTED = ("centralized", "dsgd", "gradient_tracking", "extra", "admm")
+_ALGO_CODES = {"centralized": 0, "dsgd": 1, "gradient_tracking": 2,
+               "extra": 3, "admm": 4}
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -92,7 +93,9 @@ def load_library(rebuild: bool = False) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int,            # algorithm, problem
         ctypes.c_int64, ctypes.c_int64,        # T, batch_size
         ctypes.c_double, ctypes.c_int,         # eta0, sqrt_decay
-        ctypes.c_double, ctypes.c_uint64,      # reg, seed
+        ctypes.c_double,                       # reg
+        ctypes.c_double, ctypes.c_double,      # admm_c, admm_rho
+        ctypes.c_uint64,                       # seed
         ctypes.c_int64, ctypes.c_int,          # eval_every, collect_metrics
         f64p, f64p, f64p, f64p,                # out_models/gap/cons/times
     ]
@@ -110,7 +113,7 @@ def run(
     if config.algorithm not in _SUPPORTED:
         raise ValueError(
             f"cpp backend implements {_SUPPORTED} (the reference's "
-            "algorithms plus matrix-form GT/EXTRA); "
+            "algorithms plus matrix-form GT/EXTRA/ADMM); "
             f"{config.algorithm!r} is a jax-backend capability"
         )
     if (
@@ -167,7 +170,8 @@ def run(
         T, config.local_batch_size,
         config.learning_rate_eta0,
         1 if config.resolved_lr_schedule() == "sqrt_decay" else 0,
-        config.reg_param, config.seed, eval_every,
+        config.reg_param, config.admm_c, config.admm_rho,
+        config.seed, eval_every,
         1 if collect_metrics else 0,
         out_models, out_gap, out_cons, out_times,
     )

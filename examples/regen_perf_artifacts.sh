@@ -11,7 +11,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python examples/bench_mixing.py            # -> docs/perf/mixing_bench.json
+python examples/bench_pallas_regimes.py    # -> docs/perf/pallas_regimes.json
 python examples/bench_breakdown.py         # -> docs/perf/breakdown.json
 python examples/bench_scaling.py           # -> docs/perf/scaling.json + figure
+python examples/bench_presets.py           # -> docs/perf/presets.json
+python examples/reproduce_report.py --json docs/perf/report_reproduction.json
 python examples/northstar_consensus.py --ring-full  # -> docs/perf/northstar_consensus.json
 python bench.py                            # headline JSON line (stdout)
+# docs/perf/anomaly_rootcause.json is a one-off investigation record
+# (round-3 nested-scan root cause), not regenerated here.

@@ -6,10 +6,10 @@
 // oracle backend reproduces those semantics faithfully but pays the Python
 // interpreter per iteration; this core implements the reference's two
 // algorithms (centralized SGD, D-SGD with an arbitrary dense mixing matrix)
-// PLUS matrix-form recursions of the exact first-order extensions (DIGing
-// gradient tracking, EXTRA) as tight C++ loops behind a plain C ABI, loaded
-// via ctypes — the framework's native runtime tier for hosts (the TPU tier
-// is XLA; see backends/cpp_backend.py).
+// PLUS matrix/node-form recursions of the exact methods (DIGing gradient
+// tracking, EXTRA, DLM decentralized ADMM) as tight C++ loops behind a
+// plain C ABI, loaded via ctypes — the framework's native runtime tier for
+// hosts (the TPU tier is XLA; see backends/cpp_backend.py).
 //
 // Semantics notes:
 // - Batch sampling is without replacement via partial Fisher-Yates on a
@@ -148,15 +148,20 @@ void stochastic_gradient(int problem, const double *Xs, const double *ys,
 
 extern "C" {
 
-// Shared driver for all four algorithms.
+// Shared driver for all five algorithms.
 //
 // X, y: concatenated per-worker shards, [n_total, d] row-major / [n_total];
 // offsets: [n_workers + 1] shard boundaries into X/y rows;
 // W: [n_workers, n_workers] dense mixing matrix (ignored when centralized);
 // algorithm: 0 = centralized (parameter-server SGD), 1 = D-SGD,
-//            2 = gradient tracking (DIGing), 3 = EXTRA — the latter two are
-//            the matrix recursions the numpy oracle also implements
-//            (backends/numpy_backend.py), for cross-tier verification;
+//            2 = gradient tracking (DIGing), 3 = EXTRA, 4 = decentralized
+//            linearized ADMM (DLM, Ling et al. '15) — 2..4 are the matrix
+//            recursions the numpy oracle also implements
+//            (backends/numpy_backend.py), for cross-tier verification.
+//            ADMM derives the 0/1 adjacency and degrees from W's
+//            off-diagonal support (MH weights are strictly positive on
+//            edges) and uses constant penalties (admm_c, admm_rho) — eta0
+//            and sqrt_decay are ignored for it;
 // sqrt_decay: 1 = eta0/sqrt(t+1), 0 = constant eta0;
 // out_models: [n_workers, d] final per-worker models (centralized: rows equal);
 // collect_metrics: 0 skips all objective/consensus evaluation (pure
@@ -173,17 +178,18 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
                    int64_t n_workers, int64_t d, const double *W,
                    int algorithm, int problem, int64_t T,
                    int64_t batch_size, double eta0, int sqrt_decay,
-                   double reg, uint64_t seed, int64_t eval_every,
-                   int collect_metrics,
+                   double reg, double admm_c, double admm_rho, uint64_t seed,
+                   int64_t eval_every, int collect_metrics,
                    double *out_models, double *out_gap, double *out_cons,
                    double *out_times) {
-  constexpr int kCentralized = 0, kDsgd = 1, kGT = 2, kExtra = 3;
+  constexpr int kCentralized = 0, kDsgd = 1, kGT = 2, kExtra = 3, kAdmm = 4;
   if (n_workers <= 0 || d <= 0 || T < 0 || eval_every <= 0 ||
       T % eval_every != 0 || batch_size < 0) {
     return 1;
   }
   if (problem != kLogistic && problem != kQuadratic) return 2;
-  if (algorithm < kCentralized || algorithm > kExtra) return 3;
+  if (algorithm < kCentralized || algorithm > kAdmm) return 3;
+  if (algorithm == kAdmm && (admm_c <= 0.0 || admm_rho <= 0.0)) return 4;
   const bool centralized = algorithm == kCentralized;
   const int64_t n_total = offsets[n_workers];
   const int64_t nd = n_workers * d;
@@ -194,6 +200,7 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
   std::vector<double> avg(d, 0.0);
   // Extension state (allocated only when used).
   std::vector<double> y_trk, g_prev, x_prev, Wx_prev, Wy;
+  std::vector<double> adj, deg, alpha, nbr;
   if (algorithm == kGT) {
     y_trk.assign(nd, 0.0);
     g_prev.assign(nd, 0.0);
@@ -202,6 +209,21 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
     x_prev.assign(nd, 0.0);
     Wx_prev.assign(nd, 0.0);
     g_prev.assign(nd, 0.0);
+  } else if (algorithm == kAdmm) {
+    // 0/1 adjacency + degrees from W's off-diagonal support (MH weights
+    // are strictly positive exactly on edges).
+    adj.assign(n_workers * n_workers, 0.0);
+    deg.assign(n_workers, 0.0);
+    for (int64_t i = 0; i < n_workers; ++i) {
+      for (int64_t j = 0; j < n_workers; ++j) {
+        if (i != j && W[i * n_workers + j] > 0.0) {
+          adj[i * n_workers + j] = 1.0;
+          deg[i] += 1.0;
+        }
+      }
+    }
+    alpha.assign(nd, 0.0);
+    nbr.assign(nd, 0.0);  // A x_0 = 0 for x_0 = 0 (matches algorithms/admm.py)
   }
 
   // grads <- per-worker stochastic gradient at `at` (row i per worker, or
@@ -230,19 +252,23 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
     }
   };
 
-  // out <- W @ in ([N, d] row-major).
-  auto apply_W = [&](const std::vector<double> &in, std::vector<double> &out) {
+  // out <- mat @ in ([N, d] row-major; mat is [N, N] row-major).
+  auto apply_mat = [&](const double *mat, const std::vector<double> &in,
+                       std::vector<double> &out) {
 #pragma omp parallel for schedule(static)
     for (int64_t i = 0; i < n_workers; ++i) {
       double *oi = out.data() + i * d;
       std::memset(oi, 0, sizeof(double) * d);
       for (int64_t j = 0; j < n_workers; ++j) {
-        const double w_ij = W[i * n_workers + j];
+        const double w_ij = mat[i * n_workers + j];
         if (w_ij == 0.0) continue;
         const double *xj = in.data() + j * d;
         for (int64_t k = 0; k < d; ++k) oi[k] += w_ij * xj[k];
       }
     }
+  };
+  auto apply_W = [&](const std::vector<double> &in, std::vector<double> &out) {
+    apply_mat(W, in, out);
   };
 
   const auto run_start = std::chrono::steady_clock::now();
@@ -282,6 +308,40 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       for (int64_t r = 0; r < nd; ++r) {
         y_trk[r] = Wy[r] + grads[r] - g_prev[r];
         g_prev[r] = grads[r];
+      }
+    } else if (algorithm == kAdmm) {
+      // DLM (Ling et al. '15), node form — same recursion as
+      // algorithms/admm.py and numpy_backend's half-Laplacian matrix form:
+      //   x_{k+1} = (rho x + c/2 (deg x + A x) - g - alpha) / (rho + c deg)
+      //   nbr     = A x_{k+1}
+      //   alpha  += c/2 (deg x_{k+1} - nbr)
+      // `nbr` carries A x across iterations (one exchange per step).
+      compute_grads(models.data(), /*shared=*/false, t);
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < n_workers; ++i) {
+        const double di = deg[i];
+        const double inv_denom = 1.0 / (admm_rho + admm_c * di);
+        double *mi = mixed.data() + i * d;
+        const double *xi = models.data() + i * d;
+        const double *gi = grads.data() + i * d;
+        const double *ai = alpha.data() + i * d;
+        const double *ni = nbr.data() + i * d;
+        for (int64_t k = 0; k < d; ++k) {
+          mi[k] = (admm_rho * xi[k] + 0.5 * admm_c * (di * xi[k] + ni[k]) -
+                   gi[k] - ai[k]) *
+                  inv_denom;
+        }
+      }
+      models.swap(mixed);
+      apply_mat(adj.data(), models, nbr);
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < n_workers; ++i) {
+        const double di = deg[i];
+        double *ai = alpha.data() + i * d;
+        const double *xi = models.data() + i * d;
+        const double *ni = nbr.data() + i * d;
+        for (int64_t k = 0; k < d; ++k)
+          ai[k] += 0.5 * admm_c * (di * xi[k] - ni[k]);
       }
     } else {  // kExtra
       // EXTRA: x_1 = W x_0 - eta g(x_0);

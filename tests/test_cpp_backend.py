@@ -97,7 +97,7 @@ def test_deterministic_given_seed(data):
 def test_rejects_unsupported(data):
     ds, f_opt = data
     with pytest.raises(ValueError, match="jax-backend capability"):
-        cpp_backend.run(CFG.replace(algorithm="admm"), ds, f_opt)
+        cpp_backend.run(CFG.replace(algorithm="choco"), ds, f_opt)
     with pytest.raises(ValueError, match="jax-only"):
         cpp_backend.run(CFG.replace(edge_drop_prob=0.2), ds, f_opt)
 
@@ -121,18 +121,19 @@ def test_backend_dispatch():
     assert len(r.history.objective) == 50
 
 
-@pytest.mark.parametrize("algorithm", ["gradient_tracking", "extra"])
+@pytest.mark.parametrize("algorithm", ["gradient_tracking", "extra", "admm"])
 def test_extensions_match_numpy_oracle_exactly_on_full_batches(data, algorithm):
     """Full-batch (b >= shard size) constant-step runs are deterministic —
     no sampling dependence — so the C++ matrix recursions must agree with the
     numpy oracle's to fp tolerance, and both must pin the sklearn optimum
-    where D-SGD stalls (third independent implementation of GT/EXTRA)."""
+    where D-SGD stalls (third independent implementation of GT/EXTRA/ADMM)."""
     from distributed_optimization_tpu.backends import numpy_backend
 
     ds, f_opt = data
     cfg = CFG.replace(
         algorithm=algorithm, n_iterations=2000, local_batch_size=50,
         lr_schedule="constant", learning_rate_eta0=0.02, eval_every=100,
+        admm_rho=2.0, admm_c=0.5,
     )
     rc = cpp_backend.run(cfg, ds, f_opt)
     rn = numpy_backend.run(cfg.replace(backend="numpy"), ds, f_opt)
@@ -142,6 +143,27 @@ def test_extensions_match_numpy_oracle_exactly_on_full_batches(data, algorithm):
                                rtol=1e-7, atol=1e-9)
     assert abs(rc.history.objective[-1]) < 1e-5
     assert rc.history.consensus_error[-1] < 1e-8
+    assert rc.total_floats_transmitted == rn.total_floats_transmitted
+
+
+def test_admm_on_erdos_renyi_matches_numpy(data):
+    """The BASELINE ADMM target graph (Erdős–Rényi) through the C++ tier:
+    the adjacency/degrees derived from W's off-diagonal support must
+    reproduce the numpy oracle's half-Laplacian recursion exactly on
+    deterministic full-batch runs."""
+    from distributed_optimization_tpu.backends import numpy_backend
+
+    cfg = CFG.replace(
+        algorithm="admm", topology="erdos_renyi", n_workers=16,
+        n_iterations=1000, local_batch_size=50, eval_every=100,
+        admm_rho=2.0, admm_c=0.5,
+    )
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    rc = cpp_backend.run(cfg, ds, f_opt)
+    rn = numpy_backend.run(cfg.replace(backend="numpy"), ds, f_opt)
+    np.testing.assert_allclose(rc.final_models, rn.final_models,
+                               rtol=1e-9, atol=1e-10)
     assert rc.total_floats_transmitted == rn.total_floats_transmitted
 
 
