@@ -36,9 +36,11 @@ from distributed_optimization_tpu.metrics import (
 from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.utils.data import HostDataset
 
-_SUPPORTED = ("centralized", "dsgd", "gradient_tracking", "extra", "admm")
+_SUPPORTED = ("centralized", "dsgd", "gradient_tracking", "extra", "admm",
+              "choco")
 _ALGO_CODES = {"centralized": 0, "dsgd": 1, "gradient_tracking": 2,
-               "extra": 3, "admm": 4}
+               "extra": 3, "admm": 4, "choco": 5}
+_COMPRESSION_CODES = {"none": 0, "top_k": 1}
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -95,6 +97,8 @@ def load_library(rebuild: bool = False) -> ctypes.CDLL:
         ctypes.c_double, ctypes.c_int,         # eta0, sqrt_decay
         ctypes.c_double,                       # reg
         ctypes.c_double, ctypes.c_double,      # admm_c, admm_rho
+        ctypes.c_int, ctypes.c_int64,          # compression, comp_k
+        ctypes.c_double,                       # choco_gamma
         ctypes.c_uint64,                       # seed
         ctypes.c_int64, ctypes.c_int,          # eval_every, collect_metrics
         f64p, f64p, f64p, f64p,                # out_models/gap/cons/times
@@ -122,6 +126,14 @@ def run(
         or config.gossip_schedule != "synchronous"
     ):
         raise ValueError("failure injection / one-peer gossip is jax-only")
+    if config.algorithm == "choco" and config.compression not in _COMPRESSION_CODES:
+        raise ValueError(
+            "the cpp CHOCO tier supports the deterministic compressors "
+            "(none, top_k); random_k/qsgd draw from the jax counter-based "
+            "PRNG inside the step, which an independent native "
+            "implementation cannot reproduce (same stance as the numpy "
+            "oracle)"
+        )
     lib = load_library()
 
     n = config.n_workers
@@ -151,10 +163,18 @@ def run(
             seed=config.seed,
         )
         W = np.ascontiguousarray(topo.mixing_matrix, dtype=np.float64)
-        # GT gossips both x and y per iteration (gossip_rounds=2).
-        floats_per_iter = decentralized_floats_per_iteration(
-            topo, d, get_algorithm(config.algorithm).gossip_rounds
-        )
+        algo = get_algorithm(config.algorithm)
+        if algo.comm_payload is not None:
+            # Compressed gossip transmits the compressor's payload per edge
+            # (same accounting as the jax and numpy backends).
+            floats_per_iter = topo.floats_per_iteration * algo.comm_payload(
+                config, d
+            )
+        else:
+            # GT gossips both x and y per iteration (gossip_rounds=2).
+            floats_per_iter = decentralized_floats_per_iteration(
+                topo, d, algo.gossip_rounds
+            )
         spectral_gap = topo.spectral_gap
 
     out_models = np.zeros((n, d), dtype=np.float64)
@@ -171,6 +191,8 @@ def run(
         config.learning_rate_eta0,
         1 if config.resolved_lr_schedule() == "sqrt_decay" else 0,
         config.reg_param, config.admm_c, config.admm_rho,
+        _COMPRESSION_CODES.get(config.compression, 0),
+        config.compression_k or 0, config.choco_gamma,
         config.seed, eval_every,
         1 if collect_metrics else 0,
         out_models, out_gap, out_cons, out_times,

@@ -21,6 +21,7 @@
 //   ops/losses_np.py (stable softplus for logistic).
 // - float64 throughout, like the numpy oracle.
 
+#include <algorithm>
 #include <chrono>
 #include <cmath>
 #include <cstdint>
@@ -155,13 +156,18 @@ extern "C" {
 // W: [n_workers, n_workers] dense mixing matrix (ignored when centralized);
 // algorithm: 0 = centralized (parameter-server SGD), 1 = D-SGD,
 //            2 = gradient tracking (DIGing), 3 = EXTRA, 4 = decentralized
-//            linearized ADMM (DLM, Ling et al. '15) — 2..4 are the matrix
-//            recursions the numpy oracle also implements
+//            linearized ADMM (DLM, Ling et al. '15), 5 = CHOCO-SGD
+//            (Koloskova et al. '19 Alg. 2, deterministic compressors) —
+//            2..5 are the recursions the numpy oracle also implements
 //            (backends/numpy_backend.py), for cross-tier verification.
 //            ADMM derives the 0/1 adjacency and degrees from W's
 //            off-diagonal support (MH weights are strictly positive on
 //            edges) and uses constant penalties (admm_c, admm_rho) — eta0
-//            and sqrt_decay are ignored for it;
+//            and sqrt_decay are ignored for it. CHOCO uses
+//            (compression, comp_k, choco_gamma): compression 0 = identity,
+//            1 = per-row top-k by magnitude with ties broken toward the
+//            lower index (a stable descending sort — matches lax.top_k and
+//            the numpy oracle);
 // sqrt_decay: 1 = eta0/sqrt(t+1), 0 = constant eta0;
 // out_models: [n_workers, d] final per-worker models (centralized: rows equal);
 // collect_metrics: 0 skips all objective/consensus evaluation (pure
@@ -178,18 +184,26 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
                    int64_t n_workers, int64_t d, const double *W,
                    int algorithm, int problem, int64_t T,
                    int64_t batch_size, double eta0, int sqrt_decay,
-                   double reg, double admm_c, double admm_rho, uint64_t seed,
+                   double reg, double admm_c, double admm_rho,
+                   int compression, int64_t comp_k, double choco_gamma,
+                   uint64_t seed,
                    int64_t eval_every, int collect_metrics,
                    double *out_models, double *out_gap, double *out_cons,
                    double *out_times) {
-  constexpr int kCentralized = 0, kDsgd = 1, kGT = 2, kExtra = 3, kAdmm = 4;
+  constexpr int kCentralized = 0, kDsgd = 1, kGT = 2, kExtra = 3, kAdmm = 4,
+                kChoco = 5;
   if (n_workers <= 0 || d <= 0 || T < 0 || eval_every <= 0 ||
       T % eval_every != 0 || batch_size < 0) {
     return 1;
   }
   if (problem != kLogistic && problem != kQuadratic) return 2;
-  if (algorithm < kCentralized || algorithm > kAdmm) return 3;
+  if (algorithm < kCentralized || algorithm > kChoco) return 3;
   if (algorithm == kAdmm && (admm_c <= 0.0 || admm_rho <= 0.0)) return 4;
+  if (algorithm == kChoco &&
+      (choco_gamma <= 0.0 || compression < 0 || compression > 1 ||
+       (compression == 1 && (comp_k <= 0 || comp_k > d)))) {
+    return 5;
+  }
   const bool centralized = algorithm == kCentralized;
   const int64_t n_total = offsets[n_workers];
   const int64_t nd = n_workers * d;
@@ -224,6 +238,12 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
     }
     alpha.assign(nd, 0.0);
     nbr.assign(nd, 0.0);  // A x_0 = 0 for x_0 = 0 (matches algorithms/admm.py)
+  }
+  std::vector<double> xhat, x_half, Wxhat;
+  if (algorithm == kChoco) {
+    xhat.assign(nd, 0.0);
+    x_half.assign(nd, 0.0);
+    Wxhat.assign(nd, 0.0);
   }
 
   // grads <- per-worker stochastic gradient at `at` (row i per worker, or
@@ -308,6 +328,50 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       for (int64_t r = 0; r < nd; ++r) {
         y_trk[r] = Wy[r] + grads[r] - g_prev[r];
         g_prev[r] = grads[r];
+      }
+    } else if (algorithm == kChoco) {
+      // CHOCO-SGD (Koloskova et al. '19 Alg. 2):
+      //   x_half = x − η g(x)
+      //   x̂    += Q(x_half − x̂)        ← the only bits transmitted
+      //   x      = x_half + γ (W x̂ − x̂)
+      // Q = identity or per-row top-k by |v| (stable descending order, ties
+      // toward the lower index — the numpy oracle's _topk_rows exactly).
+      compute_grads(models.data(), /*shared=*/false, t);
+#pragma omp parallel
+      {
+        std::vector<int64_t> order;
+#pragma omp for schedule(static)
+        for (int64_t i = 0; i < n_workers; ++i) {
+          double *hi = x_half.data() + i * d;
+          const double *xi = models.data() + i * d;
+          const double *gi = grads.data() + i * d;
+          double *xh = xhat.data() + i * d;
+          for (int64_t k = 0; k < d; ++k) hi[k] = xi[k] - eta * gi[k];
+          if (compression == 0) {
+            for (int64_t k = 0; k < d; ++k) xh[k] = hi[k];
+          } else {
+            order.resize(d);
+            for (int64_t k = 0; k < d; ++k) order[k] = k;
+            // Stable descending sort by |x_half − x̂|; take the first k.
+            std::stable_sort(order.begin(), order.end(),
+                             [&](int64_t a, int64_t b) {
+                               return std::fabs(hi[a] - xh[a]) >
+                                      std::fabs(hi[b] - xh[b]);
+                             });
+            for (int64_t r = 0; r < comp_k; ++r)
+              xh[order[r]] = hi[order[r]];  // x̂ += (x_half − x̂) on support
+          }
+        }
+      }
+      apply_W(xhat, Wxhat);
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < n_workers; ++i) {
+        double *xi = models.data() + i * d;
+        const double *hi = x_half.data() + i * d;
+        const double *wi = Wxhat.data() + i * d;
+        const double *xh = xhat.data() + i * d;
+        for (int64_t k = 0; k < d; ++k)
+          xi[k] = hi[k] + choco_gamma * (wi[k] - xh[k]);
       }
     } else if (algorithm == kAdmm) {
       // DLM (Ling et al. '15), node form — same recursion as

@@ -95,9 +95,13 @@ def test_deterministic_given_seed(data):
 
 
 def test_rejects_unsupported(data):
+    """All six algorithms now run on the cpp tier; the remaining carve-outs
+    are fault injection (jax-only) and randomized CHOCO compressors
+    (tested separately)."""
     ds, f_opt = data
-    with pytest.raises(ValueError, match="jax-backend capability"):
-        cpp_backend.run(CFG.replace(algorithm="choco"), ds, f_opt)
+    assert set(cpp_backend._SUPPORTED) == {
+        "centralized", "dsgd", "gradient_tracking", "extra", "admm", "choco"
+    }
     with pytest.raises(ValueError, match="jax-only"):
         cpp_backend.run(CFG.replace(edge_drop_prob=0.2), ds, f_opt)
 
@@ -144,6 +148,60 @@ def test_extensions_match_numpy_oracle_exactly_on_full_batches(data, algorithm):
     assert abs(rc.history.objective[-1]) < 1e-5
     assert rc.history.consensus_error[-1] < 1e-8
     assert rc.total_floats_transmitted == rn.total_floats_transmitted
+
+
+@pytest.mark.parametrize("compression,k,gamma", [
+    ("none", None, 1.0), ("top_k", 3, 0.25),
+])
+def test_choco_matches_numpy_oracle_exactly_on_full_batches(
+    data, compression, k, gamma
+):
+    """Deterministic full-batch CHOCO (identity and top-k compressors): the
+    C++ recursion must follow the numpy oracle's trajectory exactly —
+    including 2000 rounds of identical top-k support selections (both use a
+    stable descending magnitude sort) — and transmit the same compressed
+    payload."""
+    from distributed_optimization_tpu.backends import numpy_backend
+
+    ds, f_opt = data
+    cfg = CFG.replace(
+        algorithm="choco", compression=compression, compression_k=k,
+        choco_gamma=gamma, n_iterations=2000, local_batch_size=50,
+        lr_schedule="constant", learning_rate_eta0=0.02, eval_every=100,
+    )
+    rc = cpp_backend.run(cfg, ds, f_opt)
+    rn = numpy_backend.run(cfg.replace(backend="numpy"), ds, f_opt)
+    # Slightly looser than the GT/EXTRA/ADMM 1e-9 bound: the compressor's
+    # hard support selection makes the trajectory non-smooth in its inputs,
+    # so C++-vs-numpy sum-order noise accumulates to ~2e-9 over 2000 rounds
+    # (measured; identical supports throughout — a flip would be O(1)).
+    np.testing.assert_allclose(rc.final_models, rn.final_models,
+                               rtol=1e-7, atol=1e-8)
+    # Early-transient gaps amplify the same noise through the steep
+    # quadratic (gradient norms ~1e3), so the objective band is wider.
+    np.testing.assert_allclose(rc.history.objective, rn.history.objective,
+                               rtol=1e-4, atol=1e-6)
+    assert rc.total_floats_transmitted == rn.total_floats_transmitted
+    if compression == "top_k":
+        # 2k/d of the full-vector payload (k values + k indices per edge).
+        d = ds.n_features
+        full = numpy_backend.run(
+            cfg.replace(backend="numpy", compression="none",
+                        compression_k=None), ds, f_opt,
+        )
+        assert rc.total_floats_transmitted == pytest.approx(
+            full.total_floats_transmitted * (2 * 3) / d
+        )
+
+
+def test_choco_rejects_randomized_compressors(data):
+    ds, f_opt = data
+    with pytest.raises(ValueError, match="deterministic compressors"):
+        cpp_backend.run(
+            CFG.replace(algorithm="choco", compression="qsgd",
+                        compression_k=4),
+            ds, f_opt,
+        )
 
 
 def test_admm_on_erdos_renyi_matches_numpy(data):
