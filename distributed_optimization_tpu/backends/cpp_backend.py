@@ -3,9 +3,10 @@
 The framework's native runtime tier for host-side execution: the reference's
 two algorithms (centralized SGD and D-SGD with a dense mixing matrix —
 reference ``trainer.py:7-74``/``76-197``) plus matrix/node-form recursions
-of the exact methods (DIGing gradient tracking, EXTRA, and DLM decentralized
-ADMM — the same recursions the numpy oracle implements, giving a third
-independent implementation for cross-tier verification), compiled from
+of the extensions (DIGing gradient tracking, EXTRA, DLM decentralized ADMM,
+and CHOCO-SGD with deterministic compressors — the same recursions the
+numpy oracle implements, giving a third independent implementation for
+cross-tier verification), compiled from
 ``native/src/gossip_core.cpp`` into a shared library (OpenMP-parallel
 worker loop, stable closed-form objectives). Fidelity-sensitive work stays on
 the numpy oracle (exact reference semantics, injectable batches); this tier
@@ -117,7 +118,7 @@ def run(
     if config.algorithm not in _SUPPORTED:
         raise ValueError(
             f"cpp backend implements {_SUPPORTED} (the reference's "
-            "algorithms plus matrix-form GT/EXTRA/ADMM); "
+            "algorithms plus matrix/node-form GT/EXTRA/ADMM/CHOCO); "
             f"{config.algorithm!r} is a jax-backend capability"
         )
     if (

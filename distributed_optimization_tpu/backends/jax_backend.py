@@ -271,7 +271,8 @@ def run(
 PALLAS_MIN_DIM = 512
 
 
-def _resolve_auto_mixing_impl(config, topo, algo, mesh, platform: str) -> str:
+def _resolve_auto_mixing_impl(config, topo, algo, mesh, platform: str,
+                              d: int) -> str:
     """Resolve ``mixing_impl='auto'`` from measured data.
 
     Round-1 (gather era): the fused pallas ring kernel won decisively at the
@@ -301,7 +302,10 @@ def _resolve_auto_mixing_impl(config, topo, algo, mesh, platform: str) -> str:
         and topo.n >= 3
         and static_sync
         and config.dtype == "float32"
-        and config.n_features + 1 >= PALLAS_MIN_DIM
+        # d is the REAL model dimension (device_data.n_features) — the
+        # digits dataset ignores config.n_features, so deriving from the
+        # config would mis-gate it.
+        and d >= PALLAS_MIN_DIM
     ):
         return "pallas"
     return "auto"  # make_mixing_op resolves: stencil if supported, else dense
@@ -351,7 +355,8 @@ def _run(
             else:
                 mesh = make_worker_mesh(n)
         mixing_impl = _resolve_auto_mixing_impl(
-            config, topo, algo, mesh, jax.devices()[0].platform
+            config, topo, algo, mesh, jax.devices()[0].platform,
+            device_data.n_features,
         )
         if mixing_impl == "shard_map":
             if mesh is None:
@@ -676,7 +681,8 @@ def _run(
         run_seconds = time.perf_counter() - t1
         executed_iters = T
 
-        # Keep only the rows on the eval cadence (the cond filler is zeros).
+        # Keep only the rows on the eval cadence; off-cadence rows hold
+        # real (inline-computed) evals that the requested cadence discards.
         sel = slice(trips_per_eval - 1, None, trips_per_eval)
         gap_hist = (
             np.asarray(ys["gap"][sel], dtype=np.float64)

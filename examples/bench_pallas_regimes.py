@@ -143,7 +143,7 @@ def main() -> None:
             verdicts[f"d{d}_{dt}"] = {
                 "stencil": s, "pallas": p,
                 "pallas_over_stencil": (round(p / s, 3)
-                                        if p and s else "pallas unavailable"),
+                                        if p and s else "ratio unavailable"),
                 "pallas_wins_outside_noise": bool(p and s and p > 1.10 * s),
             }
     out = {

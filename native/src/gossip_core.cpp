@@ -149,7 +149,7 @@ void stochastic_gradient(int problem, const double *Xs, const double *ys,
 
 extern "C" {
 
-// Shared driver for all five algorithms.
+// Shared driver for all six algorithms.
 //
 // X, y: concatenated per-worker shards, [n_total, d] row-major / [n_total];
 // offsets: [n_workers + 1] shard boundaries into X/y rows;

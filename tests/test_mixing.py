@@ -86,43 +86,41 @@ def test_auto_impl_resolution_uses_measured_tpu_winner():
     topo = build_topology("ring", 8)
     dsgd = get_algorithm("dsgd")
 
-    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "tpu") == "pallas"
+    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "tpu", wide + 1) == "pallas"
     # The headline shape (d=81): stencil measured ahead post-flat-scan.
-    assert (
-        _resolve_auto_mixing_impl(
-            cfg.replace(n_features=80, n_informative_features=60),
-            topo, dsgd, None, "tpu",
-        )
-        == "auto"
-    )
+    # The dimension is the DATASET's, not the config's (digits ignores
+    # config.n_features).
+    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "tpu", 81) == "auto"
+
     # Outside the measured envelope: fall through to the stencil/dense rule.
-    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "cpu") == "auto"
-    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, object(), "tpu") == "auto"
+    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "cpu", wide + 1) == "auto"
+    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, object(), "tpu", wide + 1) == "auto"
     assert (
         _resolve_auto_mixing_impl(
-            cfg.replace(edge_drop_prob=0.1), topo, dsgd, None, "tpu"
+            cfg.replace(edge_drop_prob=0.1), topo, dsgd, None, "tpu", wide + 1
         )
         == "auto"
     )
     assert (
         _resolve_auto_mixing_impl(
-            cfg.replace(dtype="bfloat16"), topo, dsgd, None, "tpu"
+            cfg.replace(dtype="bfloat16"), topo, dsgd, None, "tpu", wide + 1
         )
         == "auto"
     )
     gt = get_algorithm("gradient_tracking")
-    assert _resolve_auto_mixing_impl(cfg, topo, gt, None, "tpu") == "auto"
+    assert _resolve_auto_mixing_impl(cfg, topo, gt, None, "tpu", wide + 1) == "auto"
     grid = build_topology("grid", 9)
     assert (
         _resolve_auto_mixing_impl(
-            cfg.replace(topology="grid", n_workers=9), grid, dsgd, None, "tpu"
+            cfg.replace(topology="grid", n_workers=9), grid, dsgd, None,
+            "tpu", wide + 1
         )
         == "auto"
     )
     # Explicit impls pass through untouched.
     assert (
         _resolve_auto_mixing_impl(
-            cfg.replace(mixing_impl="dense"), topo, dsgd, None, "tpu"
+            cfg.replace(mixing_impl="dense"), topo, dsgd, None, "tpu", wide + 1
         )
         == "dense"
     )
