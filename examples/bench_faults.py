@@ -1,0 +1,105 @@
+"""Measured evidence for the failure-injection subsystem (SURVEY.md §5.3).
+
+Runs the N=64 ring D-SGD config on the chip under the full fault/schedule
+matrix — fault-free, 20% iid edge drops, 10% stragglers, one-peer
+randomized gossip, deterministic round-robin matchings — and records, per
+variant: throughput, the convergence outcome, and the REALIZED
+floats-transmitted accounting next to the fault-free analytic count (the
+honest-bandwidth property the fault machinery exists to provide).
+
+Variants are interleaved round-robin per cycle (shared-chip protocol).
+Writes ``docs/perf/faults.json``.
+
+Usage:  python examples/bench_faults.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/perf/faults.json")
+    ap.add_argument("--cycles", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.metrics import iterations_to_threshold
+    from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+    from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+    base = ExperimentConfig(
+        problem_type="logistic", algorithm="dsgd", topology="ring",
+        n_workers=64, n_iterations=20_000,
+    )
+    ds = generate_synthetic_dataset(base)
+    _, f_opt = compute_reference_optimum(ds, base.reg_param)
+
+    variants = {
+        "fault_free": base,
+        "edge_drop_20pct": base.replace(edge_drop_prob=0.2),
+        "stragglers_10pct": base.replace(straggler_prob=0.1),
+        "edge20_straggler10": base.replace(edge_drop_prob=0.2,
+                                           straggler_prob=0.1),
+        "one_peer_gossip": base.replace(gossip_schedule="one_peer"),
+        "round_robin_matchings": base.replace(gossip_schedule="round_robin"),
+    }
+
+    runs: dict[str, list] = {name: [] for name in variants}
+    results: dict[str, dict] = {}
+    for c in range(args.cycles):
+        for name, cfg in variants.items():
+            r = jax_backend.run(cfg, ds, f_opt)
+            runs[name].append(float(r.history.iters_per_second))
+            if c == 0:
+                h = r.history
+                results[name] = {
+                    "final_gap": round(float(h.objective[-1]), 6),
+                    "iterations_to_eps": int(iterations_to_threshold(
+                        h.objective, cfg.suboptimality_threshold,
+                        h.eval_iterations)),
+                    "final_consensus": round(float(h.consensus_error[-1]), 8),
+                    "floats_transmitted": float(h.total_floats_transmitted),
+                }
+    analytic_full = results["fault_free"]["floats_transmitted"]
+    for name, row in results.items():
+        row["iters_per_sec_median"] = round(statistics.median(runs[name]), 1)
+        row["floats_vs_fault_free"] = round(
+            row["floats_transmitted"] / analytic_full, 4)
+        print(f"[faults] {name:24s} {row['iters_per_sec_median']:>9.0f} "
+              f"iters/sec  gap {row['final_gap']:.4f}  iters->eps "
+              f"{row['iterations_to_eps']:>6d}  floats x"
+              f"{row['floats_vs_fault_free']}", file=sys.stderr)
+
+    payload = {
+        "device": str(jax.devices()[0]),
+        "config": "dsgd ring logistic N=64 T=20k, interleaved medians of "
+                  f"{args.cycles}",
+        "note": "floats_vs_fault_free: realized (fault-accounted) floats "
+                "over the fault-free analytic 2|E|dT — edge drops at p=0.2 "
+                "should realize ~0.8, one-peer at most 1/deg_sum per node "
+                "pair, round-robin exactly 1/2 on an even ring. Convergence "
+                "under drops/stragglers degrades gracefully (time-varying "
+                "doubly stochastic W_t, Koloskova et al. '20 setting).",
+        "runs": results,
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    print(json.dumps({"metric": "fault_variants_measured",
+                      "value": len(results)}))
+
+
+if __name__ == "__main__":
+    main()

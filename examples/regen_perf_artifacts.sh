@@ -15,6 +15,7 @@ python examples/bench_pallas_regimes.py    # -> docs/perf/pallas_regimes.json
 python examples/bench_breakdown.py         # -> docs/perf/breakdown.json
 python examples/bench_scaling.py           # -> docs/perf/scaling.json + figure
 python examples/bench_presets.py           # -> docs/perf/presets.json
+python examples/bench_faults.py            # -> docs/perf/faults.json
 python examples/reproduce_report.py --json docs/perf/report_reproduction.json
 python examples/northstar_consensus.py --ring-full  # -> docs/perf/northstar_consensus.json
 python bench.py                            # headline JSON line (stdout)
