@@ -187,7 +187,7 @@ def run(
     rc = lib.run_simulation(
         X, y, offsets, n, d, W,
         _ALGO_CODES[config.algorithm],
-        0 if config.problem_type == "logistic" else 1,
+        {"logistic": 0, "quadratic": 1, "huber": 2}[config.problem_type],
         T, config.local_batch_size,
         config.learning_rate_eta0,
         1 if config.resolved_lr_schedule() == "sqrt_decay" else 0,

@@ -21,7 +21,7 @@ ALGORITHMS = ("centralized", "dsgd", "gradient_tracking", "extra", "admm", "choc
 
 TOPOLOGIES = ("ring", "grid", "fully_connected", "erdos_renyi", "chain", "star")
 
-PROBLEM_TYPES = ("logistic", "quadratic")
+PROBLEM_TYPES = ("logistic", "quadratic", "huber")
 
 BACKENDS = ("jax", "numpy", "cpp")
 
@@ -232,10 +232,12 @@ class ExperimentConfig:
     # (reference worker.py:36-42, main.py:20-21).
     @property
     def reg_param(self) -> float:
+        # Convex problems (logistic, huber) use lambda; the strongly convex
+        # quadratic uses mu (== lambda by default), mirroring the reference.
         return (
-            self.l2_regularization_lambda
-            if self.problem_type == "logistic"
-            else self.strong_convexity_mu
+            self.strong_convexity_mu
+            if self.problem_type == "quadratic"
+            else self.l2_regularization_lambda
         )
 
     def to_dict(self) -> dict[str, Any]:

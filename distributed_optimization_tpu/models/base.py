@@ -49,7 +49,7 @@ def register_problem(problem: Problem) -> Problem:
 def get_problem(name: str) -> Problem:
     """Look up a problem family by name ('logistic', 'quadratic', ...)."""
     # Import here so registration happens on first use without import cycles.
-    from distributed_optimization_tpu.models import logistic, quadratic  # noqa: F401
+    from distributed_optimization_tpu.models import huber, logistic, quadratic  # noqa: F401
 
     if name not in _REGISTRY:
         raise ValueError(f"Unknown problem type: {name!r}; known: {sorted(_REGISTRY)}")

@@ -1,0 +1,23 @@
+"""L2-regularized Huber regression (convex, robust).
+
+Not in the reference (``obj_problems.py`` has logistic + least squares);
+this is the framework's third objective family — robust regression with the
+per-sample gradient capped at δ‖x‖ (δ fixed at the synthetic data's noise
+scale; see ``ops/losses.py``). Uses the same regression data pipeline as
+the quadratic problem and a scipy L-BFGS reference optimum
+(``utils/oracle.py`` — sklearn's HuberRegressor jointly estimates a scale
+parameter and does not minimize this objective).
+"""
+
+from distributed_optimization_tpu.models.base import Problem, register_problem
+from distributed_optimization_tpu.ops import losses
+
+HUBER = register_problem(
+    Problem(
+        name="huber",
+        objective=losses.huber_objective,
+        gradient=losses.huber_gradient,
+        objective_weighted=losses.huber_objective_weighted,
+        gradient_weighted=losses.huber_gradient_weighted,
+    )
+)

@@ -53,5 +53,28 @@ def quadratic_gradient(w, X, y, mu):
     return X.T @ r / X.shape[0] + mu * w
 
 
-OBJECTIVES = {"logistic": logistic_objective, "quadratic": quadratic_objective}
-GRADIENTS = {"logistic": logistic_gradient, "quadratic": quadratic_gradient}
+HUBER_DELTA = 10.0  # must match ops/losses.py (δ at the noise scale)
+
+
+def huber_objective(w, X, y, lam):
+    if X.shape[0] == 0:
+        return 0.0
+    r = X @ w - y
+    a = np.abs(r)
+    h = np.where(a <= HUBER_DELTA, 0.5 * r * r,
+                 HUBER_DELTA * (a - 0.5 * HUBER_DELTA))
+    return float(np.mean(h) + 0.5 * lam * np.dot(w, w))
+
+
+def huber_gradient(w, X, y, lam):
+    if X.shape[0] == 0:
+        return np.zeros_like(w)
+    r = X @ w - y
+    coeff = np.clip(r, -HUBER_DELTA, HUBER_DELTA)
+    return X.T @ coeff / X.shape[0] + lam * w
+
+
+OBJECTIVES = {"logistic": logistic_objective, "quadratic": quadratic_objective,
+              "huber": huber_objective}
+GRADIENTS = {"logistic": logistic_gradient, "quadratic": quadratic_gradient,
+             "huber": huber_gradient}

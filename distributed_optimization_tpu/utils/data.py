@@ -88,7 +88,9 @@ def generate_synthetic_dataset(config) -> HostDataset:
             random_state=config.seed,
         )
         y = y.astype(np.float64) * 2.0 - 1.0
-    elif config.problem_type == "quadratic":
+    elif config.problem_type in ("quadratic", "huber"):
+        # Huber shares the regression pipeline (same targets, same noise=10
+        # scale its delta is calibrated to).
         X, y = make_regression(
             n_samples=config.n_samples,
             n_features=config.n_features,

@@ -88,6 +88,9 @@ void sample_without_replacement(Xoshiro256ss &rng, int64_t n, int64_t b,
 // ------------------------------------------------------------- objectives
 constexpr int kLogistic = 0;
 constexpr int kQuadratic = 1;
+constexpr int kHuber = 2;
+// Must match ops/losses.py HUBER_DELTA (delta at the regression noise scale).
+constexpr double kHuberDelta = 10.0;
 
 inline double dot(const double *a, const double *b, int64_t d) {
   double acc = 0.0;
@@ -107,9 +110,14 @@ double full_objective(int problem, const double *X, const double *y,
       // stable log(1 + exp(-yz)) = max(0, -yz) + log1p(exp(-|yz|))
       double m = yz < 0.0 ? -yz : 0.0;
       acc += m + std::log1p(std::exp(-std::fabs(yz)));
-    } else {
+    } else if (problem == kQuadratic) {
       double r = z - y[i];
       acc += 0.5 * r * r;
+    } else {  // kHuber
+      double r = z - y[i];
+      double a = std::fabs(r);
+      acc += a <= kHuberDelta ? 0.5 * r * r
+                              : kHuberDelta * (a - 0.5 * kHuberDelta);
     }
   }
   double obj = acc / static_cast<double>(n);
@@ -136,8 +144,12 @@ void stochastic_gradient(int problem, const double *Xs, const double *ys,
       // -y * sigmoid(-yz)
       double s = 1.0 / (1.0 + std::exp(yz));
       coef = -ys[idx[t]] * s;
-    } else {
+    } else if (problem == kQuadratic) {
       coef = z - ys[idx[t]];
+    } else {  // kHuber: clip(r, -delta, delta)
+      double r = z - ys[idx[t]];
+      coef = r > kHuberDelta ? kHuberDelta
+                             : (r < -kHuberDelta ? -kHuberDelta : r);
     }
     for (int64_t k = 0; k < d; ++k) g_out[k] += coef * xi[k];
   }
@@ -196,7 +208,7 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       T % eval_every != 0 || batch_size < 0) {
     return 1;
   }
-  if (problem != kLogistic && problem != kQuadratic) return 2;
+  if (problem < kLogistic || problem > kHuber) return 2;
   if (algorithm < kCentralized || algorithm > kChoco) return 3;
   if (algorithm == kAdmm && (admm_c <= 0.0 || admm_rho <= 0.0)) return 4;
   if (algorithm == kChoco &&

@@ -1,0 +1,196 @@
+"""Huber regression — the framework's third objective family.
+
+Pinned: closed-form gradients vs jax.grad and finite differences (including
+across the δ transition), weighted/plain form equivalence, jax ≡ numpy-twin
+≡ C++ parity, the scipy L-BFGS oracle's stationarity, and end-to-end
+convergence on all three backends.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import batch_schedule as _schedule, small_backend_config
+from distributed_optimization_tpu.backends import run_algorithm
+from distributed_optimization_tpu.ops import losses, losses_np
+from distributed_optimization_tpu.utils import (
+    compute_reference_optimum,
+    generate_synthetic_dataset,
+)
+
+
+@pytest.fixture(scope="module")
+def huber_setup():
+    cfg = small_backend_config(problem_type="huber")
+    ds = generate_synthetic_dataset(cfg)
+    w_opt, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    return cfg, ds, w_opt, f_opt
+
+
+def _rand(shape, seed=0, scale=1.0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape) * scale,
+        dtype=jnp.float64,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _x64():
+    """The exactness assertions below compare closed forms at 1e-10..1e-12;
+    without x64 jax silently truncates everything to float32."""
+    with jax.enable_x64():
+        yield
+
+
+def test_gradient_matches_autodiff_and_finite_differences():
+    """Closed-form gradient ≡ jax.grad of the objective; spot-check with
+    central differences. Residuals are scaled to straddle the δ=10
+    transition so both branches of the piecewise form are exercised."""
+    n, d = 40, 7
+    X = _rand((n, d), 1)
+    w = _rand((d,), 2)
+    y = _rand((n,), 3, scale=15.0)  # residuals span |r| <> delta
+    lam = 1e-3
+    r = np.asarray(X @ w - y)
+    assert (np.abs(r) > losses.HUBER_DELTA).any()
+    assert (np.abs(r) < losses.HUBER_DELTA).any()
+
+    g_closed = losses.huber_gradient(w, X, y, lam)
+    g_auto = jax.grad(losses.huber_objective)(w, X, y, lam)
+    np.testing.assert_allclose(np.asarray(g_closed), np.asarray(g_auto),
+                               rtol=1e-10, atol=1e-12)
+    eps = 1e-6
+    for k in (0, 3, 6):
+        e = jnp.zeros(d).at[k].set(eps)
+        fd = (losses.huber_objective(w + e, X, y, lam)
+              - losses.huber_objective(w - e, X, y, lam)) / (2 * eps)
+        assert abs(float(fd) - float(g_closed[k])) < 1e-5
+
+
+def test_weighted_forms_reduce_to_plain():
+    n, d = 30, 5
+    X, w = _rand((n, d), 4), _rand((d,), 5)
+    y = _rand((n,), 6, scale=15.0)
+    lam = 1e-3
+    wts = jnp.full((n,), 1.0 / n)
+    np.testing.assert_allclose(
+        float(losses.huber_objective_weighted(w, X, y, wts, lam)),
+        float(losses.huber_objective(w, X, y, lam)), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(losses.huber_gradient_weighted(w, X, y, wts, lam)),
+        np.asarray(losses.huber_gradient(w, X, y, lam)), rtol=1e-10,
+        atol=1e-12)
+
+
+def test_numpy_twin_matches_jax():
+    n, d = 25, 6
+    X, w = _rand((n, d), 7), _rand((d,), 8)
+    y = _rand((n,), 9, scale=15.0)
+    lam = 1e-3
+    np.testing.assert_allclose(
+        losses_np.huber_objective(np.asarray(w), np.asarray(X), np.asarray(y), lam),
+        float(losses.huber_objective(w, X, y, lam)), rtol=1e-12)
+    np.testing.assert_allclose(
+        losses_np.huber_gradient(np.asarray(w), np.asarray(X), np.asarray(y), lam),
+        np.asarray(losses.huber_gradient(w, X, y, lam)), rtol=1e-10, atol=1e-12)
+    assert losses_np.HUBER_DELTA == losses.HUBER_DELTA
+
+
+def test_oracle_is_stationary(huber_setup):
+    """The scipy L-BFGS optimum: ~zero gradient, below f(0), and f_opt is
+    the objective AT w_opt (self-consistency)."""
+    cfg, ds, w_opt, f_opt = huber_setup
+    g = losses_np.huber_gradient(w_opt, ds.X_full, ds.y_full, cfg.reg_param)
+    assert np.linalg.norm(g) < 1e-5
+    assert f_opt < losses_np.huber_objective(
+        np.zeros(ds.n_features), ds.X_full, ds.y_full, cfg.reg_param)
+    np.testing.assert_allclose(
+        f_opt, losses_np.huber_objective(w_opt, ds.X_full, ds.y_full,
+                                         cfg.reg_param), rtol=1e-12)
+
+
+def test_jax_numpy_equivalence_injected_batches(huber_setup):
+    cfg, ds, _, f_opt = huber_setup
+    T = 40
+    sched = _schedule(ds, T, 8, seed=13)
+    rj = run_algorithm(cfg.replace(n_iterations=T), ds, f_opt,
+                       batch_schedule=sched)
+    rn = run_algorithm(cfg.replace(n_iterations=T, backend="numpy"), ds,
+                       f_opt, batch_schedule=sched)
+    np.testing.assert_allclose(rj.final_models, rn.final_models,
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(rj.history.objective, rn.history.objective,
+                               rtol=2e-3, atol=5e-3)
+
+
+def test_cpp_tier_tracks_numpy(huber_setup):
+    cpp_backend = pytest.importorskip(
+        "distributed_optimization_tpu.backends.cpp_backend")
+    try:
+        cpp_backend.load_library()
+    except cpp_backend.NativeBuildError:
+        pytest.skip("native toolchain unavailable")
+    cfg, ds, _, f_opt = huber_setup
+    # Full-batch deterministic: the C++ huber forms must agree with the
+    # numpy oracle to fp tolerance (same standard as the other problems).
+    kw = dict(n_iterations=300, local_batch_size=50, lr_schedule="constant",
+              learning_rate_eta0=0.02, eval_every=30)
+    rc = cpp_backend.run(cfg.replace(**kw), ds, f_opt)
+    rn = run_algorithm(cfg.replace(backend="numpy", **kw), ds, f_opt)
+    np.testing.assert_allclose(rc.final_models, rn.final_models,
+                               rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(rc.history.objective, rn.history.objective,
+                               rtol=1e-7, atol=1e-9)
+
+
+@pytest.mark.parametrize("backend", ["jax", "numpy"])
+def test_dsgd_converges_toward_oracle(huber_setup, backend):
+    """Sqrt-decay D-SGD drives the suboptimality gap down by >100× from the
+    zero-init value (the gap starts ~1e3 at regression target scale)."""
+    cfg, ds, _, f_opt = huber_setup
+    r = run_algorithm(
+        cfg.replace(backend=backend, n_iterations=2000, eval_every=100,
+                    learning_rate_eta0=0.2),
+        ds, f_opt,
+    )
+    gaps = r.history.objective
+    assert np.all(np.isfinite(gaps))
+    assert gaps[-1] < 1e-2 * gaps[0]
+    assert r.history.consensus_error[-1] < 1.0
+
+
+@pytest.mark.parametrize("algorithm", ["gradient_tracking", "extra"])
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_exact_methods_pin_oracle_where_dsgd_stalls(huber_setup, algorithm,
+                                                    backend):
+    """Constant-step full-batch GT/EXTRA drive the huber gap to the scipy
+    oracle's own precision (~1e-12) while D-SGD stalls at its non-IID bias
+    floor (~1e-2) — the study's core phenomenon, on the third objective
+    family. η=0.05: larger steps (0.2+) limit-cycle around the Huber kink
+    boundaries instead of converging (measured; H_δ is C¹ but not C²)."""
+    cfg, ds, _, f_opt = huber_setup
+    kw = dict(n_iterations=4000, local_batch_size=50, lr_schedule="constant",
+              learning_rate_eta0=0.05, eval_every=400, dtype="float64",
+              backend=backend)
+    exact = run_algorithm(cfg.replace(algorithm=algorithm, **kw), ds, f_opt)
+    dsgd = run_algorithm(cfg.replace(algorithm="dsgd", **kw), ds, f_opt)
+    assert abs(exact.history.objective[-1]) < 1e-9
+    assert exact.history.consensus_error[-1] < 1e-12
+    assert dsgd.history.objective[-1] > 1e-3
+    assert dsgd.history.consensus_error[-1] > 1e-3
+
+
+def test_cli_runs_huber(tmp_path):
+    import json
+
+    from distributed_optimization_tpu.cli import main
+
+    out = tmp_path / "h.json"
+    rc = main(["--problem-type", "huber", "--n-workers", "8", "--n-samples",
+               "400", "--n-features", "10", "--n-informative-features", "6",
+               "--n-iterations", "30", "--platform", "cpu", "--quiet",
+               "--json", str(out)])
+    assert rc == 0
+    blob = json.loads(out.read_text())
+    assert blob["runs"][0]["history"]["objective"]
