@@ -72,7 +72,19 @@ def main() -> None:
                     "final_consensus": round(float(h.consensus_error[-1]), 8),
                     "floats_transmitted": float(h.total_floats_transmitted),
                 }
-    analytic_full = results["fault_free"]["floats_transmitted"]
+    # Analytic fault-free denominator 2|E|·d·T, computed independently of
+    # the backend's accounting — and the fault-free run must MATCH it
+    # exactly, so a broken accounting can't silently renormalize every
+    # ratio back to the theoretical values.
+    from distributed_optimization_tpu.parallel import build_topology
+
+    topo = build_topology(base.topology, base.n_workers)
+    analytic_full = float(
+        topo.floats_per_iteration * ds.n_features * base.n_iterations
+    )
+    assert results["fault_free"]["floats_transmitted"] == analytic_full, (
+        "fault-free realized floats diverge from the analytic 2|E|dT"
+    )
     for name, row in results.items():
         row["iters_per_sec_median"] = round(statistics.median(runs[name]), 1)
         row["floats_vs_fault_free"] = round(
@@ -87,7 +99,7 @@ def main() -> None:
         "config": "dsgd ring logistic N=64 T=20k, interleaved medians of "
                   f"{args.cycles}",
         "note": "floats_vs_fault_free: realized (fault-accounted) floats "
-                "over the fault-free analytic 2|E|dT — edge drops at p=0.2 "
+                "over the ANALYTIC 2|E|dT (fault-free run asserted equal) — edge drops at p=0.2 "
                 "should realize ~0.8, one-peer at most 1/deg_sum per node "
                 "pair, round-robin exactly 1/2 on an even ring. Convergence "
                 "under drops/stragglers degrades gracefully (time-varying "
