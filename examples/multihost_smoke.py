@@ -37,18 +37,21 @@ DEVICES_PER_PROCESS = 4
 def worker(process_id: int, coordinator: str, out_path: str) -> None:
     # Env (JAX_PLATFORMS / XLA_FLAGS) is set by the launcher BEFORE python
     # starts, so jax initializes the virtual CPU devices correctly here.
+    # ``process_id == -1`` is the single-process ground-truth run: the same
+    # config on one process holding all 8 devices, no jax.distributed.
     import jax
 
     # The axon TPU plugin's sitecustomize pins jax_platforms via jax.config,
     # which overrides the env var; re-pin CPU before any backend initializes
     # (same workaround as tests/conftest.py).
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=N_PROCESSES,
-        process_id=process_id,
-    )
-    assert jax.process_count() == N_PROCESSES
+    if process_id >= 0:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=N_PROCESSES,
+            process_id=process_id,
+        )
+        assert jax.process_count() == N_PROCESSES
     assert len(jax.devices()) == N_PROCESSES * DEVICES_PER_PROCESS
 
     import numpy as np
@@ -98,6 +101,7 @@ def launch() -> int:
 
     tmp = tempfile.mkdtemp(prefix="multihost_smoke_")
     outs = [os.path.join(tmp, f"proc{i}.json") for i in range(N_PROCESSES)]
+    single_out = os.path.join(tmp, "proc_single.json")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
@@ -105,6 +109,15 @@ def launch() -> int:
     )
     # Scrub any inherited single-controller/TPU plugin state.
     env.pop("JAX_PLATFORM_NAME", None)
+
+    # Single-process ground truth: all 8 devices in ONE process, same
+    # config. The two distributed processes agreeing with EACH OTHER could
+    # hide a correlated multi-process error; agreeing with this run cannot.
+    env_single = dict(env)
+    env_single["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count="
+        f"{N_PROCESSES * DEVICES_PER_PROCESS}"
+    )
 
     procs = [
         subprocess.Popen(
@@ -118,6 +131,17 @@ def launch() -> int:
             cwd=REPO_ROOT,
         )
         for i in range(N_PROCESSES)
+    ] + [
+        subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--process-id", "-1",
+                "--coordinator", "unused",
+                "--out", single_out,
+            ],
+            env=env_single,
+            cwd=REPO_ROOT,
+        )
     ]
     try:
         # Shorter than the pytest wrapper's 540 s timeout, so a hung worker
@@ -150,9 +174,25 @@ def launch() -> int:
     )
     assert a["total_floats"] == b["total_floats"]
     assert np.all(np.isfinite(np.asarray(a["objective"])))
+    # Cross-execution-topology equivalence: the 2-process run must match
+    # the single-process 8-device ground truth (same global mesh/sharding,
+    # different process boundaries; f32 tolerance for collective-order
+    # differences).
+    s = json.load(open(single_out))
+    np.testing.assert_allclose(
+        np.asarray(a["final_models"]), np.asarray(s["final_models"]),
+        rtol=1e-5, atol=1e-6,
+        err_msg="2-process run diverges from the single-process ground truth",
+    )
+    np.testing.assert_allclose(
+        np.asarray(a["objective"]), np.asarray(s["objective"]),
+        rtol=1e-4, atol=1e-6,
+    )
+    assert a["total_floats"] == s["total_floats"]
     print(
         "[multihost_smoke] OK: 2 processes x 4 devices, identical fetched "
-        f"results; final gap {a['objective'][-1]:.6f}"
+        "results, matching the single-process ground truth; final gap "
+        f"{a['objective'][-1]:.6f}"
     )
     return 0
 
