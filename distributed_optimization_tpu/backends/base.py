@@ -21,6 +21,11 @@ class BackendRunResult:
     history: RunHistory
     final_models: np.ndarray  # [N, d] per-worker models after T iterations
     final_avg_model: np.ndarray  # [d] network average (the reported model)
+    # Full final algorithm state (every leaf, e.g. gradient tracking's
+    # y/g_prev), host-fetched. Populated only on request
+    # (jax_backend.run(return_state=True)) — used by invariant-level tests
+    # (e.g. GT's tracking invariant under failure injection).
+    final_state: dict | None = None
 
     @property
     def total_floats_transmitted(self) -> float:

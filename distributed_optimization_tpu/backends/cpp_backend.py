@@ -96,7 +96,7 @@ def load_library(rebuild: bool = False) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int,            # algorithm, problem
         ctypes.c_int64, ctypes.c_int64,        # T, batch_size
         ctypes.c_double, ctypes.c_int,         # eta0, sqrt_decay
-        ctypes.c_double,                       # reg
+        ctypes.c_double, ctypes.c_double,      # reg, huber_delta
         ctypes.c_double, ctypes.c_double,      # admm_c, admm_rho
         ctypes.c_int, ctypes.c_int64,          # compression, comp_k
         ctypes.c_double,                       # choco_gamma
@@ -191,7 +191,8 @@ def run(
         T, config.local_batch_size,
         config.learning_rate_eta0,
         1 if config.resolved_lr_schedule() == "sqrt_decay" else 0,
-        config.reg_param, config.admm_c, config.admm_rho,
+        config.reg_param, config.huber_delta,
+        config.admm_c, config.admm_rho,
         _COMPRESSION_CODES.get(config.compression, 0),
         config.compression_k or 0, config.choco_gamma,
         config.seed, eval_every,

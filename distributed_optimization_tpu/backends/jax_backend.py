@@ -227,6 +227,7 @@ def run(
     measure_compile: bool = True,
     checkpoint=None,
     measure_timestamps: Optional[bool] = None,
+    return_state: bool = False,
 ) -> BackendRunResult:
     """Run one experiment on the JAX backend; returns histories + final models.
 
@@ -256,6 +257,7 @@ def run(
             batch_schedule=batch_schedule, collect_metrics=collect_metrics,
             measure_compile=measure_compile, checkpoint=checkpoint,
             measure_timestamps=measure_timestamps,
+            return_state=return_state,
         )
 
 
@@ -323,6 +325,7 @@ def _run(
     measure_compile: bool = True,
     checkpoint=None,
     measure_timestamps: Optional[bool] = None,
+    return_state: bool = False,
 ) -> BackendRunResult:
     """Backend implementation (see ``run``).
 
@@ -335,7 +338,7 @@ def _run(
     resume), instead of one fully fused scan.
     """
     algo = get_algorithm(config.algorithm)
-    problem = get_problem(config.problem_type)
+    problem = get_problem(config.problem_type, huber_delta=config.huber_delta)
     reg = config.reg_param
     T = config.n_iterations
     n = config.n_workers
@@ -743,4 +746,12 @@ def _run(
         history=history,
         final_models=final_models,
         final_avg_model=final_models.mean(axis=0),
+        final_state=(
+            {
+                k: _fetch_to_host(v).astype(np.float64)
+                for k, v in final_state.items()
+            }
+            if return_state
+            else None
+        ),
     )

@@ -28,6 +28,7 @@ without importing the very code under test — the deterministic compressors
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Optional
 
@@ -98,6 +99,9 @@ def run(
     reg = config.reg_param
     objective = losses_np.OBJECTIVES[config.problem_type]
     gradient = losses_np.GRADIENTS[config.problem_type]
+    if config.problem_type == "huber":
+        objective = functools.partial(objective, delta=config.huber_delta)
+        gradient = functools.partial(gradient, delta=config.huber_delta)
 
     shards = [dataset.shard(i) for i in range(n)]
     shard_sizes = [Xi.shape[0] for Xi, _ in shards]

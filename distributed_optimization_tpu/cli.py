@@ -123,6 +123,9 @@ def build_parser() -> argparse.ArgumentParser:
                      default=_DEFAULTS.lr_schedule)
     opt.add_argument("--admm-c", type=float, default=_DEFAULTS.admm_c)
     opt.add_argument("--admm-rho", type=float, default=_DEFAULTS.admm_rho)
+    opt.add_argument("--huber-delta", type=float, default=_DEFAULTS.huber_delta,
+                     help="Huber transition point δ (problem huber only; "
+                          "default = the synthetic data's noise scale)")
     opt.add_argument("--erdos-renyi-p", type=float,
                      default=_DEFAULTS.erdos_renyi_p)
     opt.add_argument("--compression", choices=COMPRESSIONS,
@@ -246,6 +249,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         lr_schedule=args.lr_schedule,
         admm_c=args.admm_c,
         admm_rho=args.admm_rho,
+        huber_delta=args.huber_delta,
         compression=args.compression,
         compression_k=args.compression_k,
         choco_gamma=args.choco_gamma,

@@ -29,6 +29,14 @@ BACKENDS = ("jax", "numpy", "cpp")
 # which derives from this constant (config stays jax-free).
 COMPRESSIONS = ("none", "top_k", "random_k", "qsgd")
 
+# Default Huber transition point δ: fixed at the synthetic data's noise scale
+# (make_regression noise=10.0, utils/data.py), i.e. the kink sits at ~1σ of the
+# residuals at the optimum — the classical choice. δ is data-scale-dependent,
+# so it is a config field (``huber_delta``); this constant is the SINGLE
+# source of the default, consumed by ops/losses.py, ops/losses_np.py, and
+# (via the C ABI's huber_delta argument) native/src/gossip_core.cpp.
+DEFAULT_HUBER_DELTA = 10.0
+
 
 @dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
@@ -75,6 +83,11 @@ class ExperimentConfig:
     compression: str = "none"
     compression_k: int = 0
     choco_gamma: float = 0.3
+    # Huber transition point δ (problem_type='huber' only); see
+    # DEFAULT_HUBER_DELTA for the default's rationale. Threaded through all
+    # three tiers: jax closures (models/huber.py), numpy twins
+    # (losses_np delta kwarg), and the native core (C ABI argument).
+    huber_delta: float = DEFAULT_HUBER_DELTA
     seed: int = 203  # reference seeds np.random.seed(203) at main.py:24
     eval_every: int = 1  # full-data objective eval cadence (reference: every iter)
     erdos_renyi_p: float = 0.4  # edge probability for the ER topology
@@ -145,6 +158,8 @@ class ExperimentConfig:
                     "compression_k (coordinates kept, or qsgd bits) must be "
                     f"positive when compression={self.compression!r}"
                 )
+        if self.huber_delta <= 0.0:
+            raise ValueError(f"huber_delta must be positive, got {self.huber_delta}")
         if self.algorithm == "choco" and not 0.0 < self.choco_gamma <= 1.0:
             raise ValueError(
                 f"choco_gamma must be in (0, 1], got {self.choco_gamma}"

@@ -46,11 +46,19 @@ def register_problem(problem: Problem) -> Problem:
     return problem
 
 
-def get_problem(name: str) -> Problem:
-    """Look up a problem family by name ('logistic', 'quadratic', ...)."""
+def get_problem(name: str, *, huber_delta: float | None = None) -> Problem:
+    """Look up a problem family by name ('logistic', 'quadratic', ...).
+
+    ``huber_delta`` binds the Huber transition point (ignored for other
+    families); ``None`` means the registered default
+    (config.DEFAULT_HUBER_DELTA). Per-δ Problems are cached so jit static
+    arguments stay identical across calls.
+    """
     # Import here so registration happens on first use without import cycles.
     from distributed_optimization_tpu.models import huber, logistic, quadratic  # noqa: F401
 
     if name not in _REGISTRY:
         raise ValueError(f"Unknown problem type: {name!r}; known: {sorted(_REGISTRY)}")
+    if name == "huber" and huber_delta is not None:
+        return huber.make_huber_problem(float(huber_delta))
     return _REGISTRY[name]

@@ -9,15 +9,31 @@ the quadratic problem and a scipy L-BFGS reference optimum
 parameter and does not minimize this objective).
 """
 
+import functools
+
 from distributed_optimization_tpu.models.base import Problem, register_problem
 from distributed_optimization_tpu.ops import losses
 
-HUBER = register_problem(
-    Problem(
+
+@functools.lru_cache(maxsize=None)
+def make_huber_problem(delta: float) -> Problem:
+    """Huber Problem with the transition point bound to ``delta``.
+
+    Cached per δ so a given δ always yields the SAME callable objects —
+    the backends pass these as jit static arguments, and a fresh partial
+    per call would defeat XLA's compilation cache.
+    """
+    return Problem(
         name="huber",
-        objective=losses.huber_objective,
-        gradient=losses.huber_gradient,
-        objective_weighted=losses.huber_objective_weighted,
-        gradient_weighted=losses.huber_gradient_weighted,
+        objective=functools.partial(losses.huber_objective, delta=delta),
+        gradient=functools.partial(losses.huber_gradient, delta=delta),
+        objective_weighted=functools.partial(
+            losses.huber_objective_weighted, delta=delta
+        ),
+        gradient_weighted=functools.partial(
+            losses.huber_gradient_weighted, delta=delta
+        ),
     )
-)
+
+
+HUBER = register_problem(make_huber_problem(losses.HUBER_DELTA))

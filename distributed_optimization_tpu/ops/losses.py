@@ -22,6 +22,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from distributed_optimization_tpu.config import DEFAULT_HUBER_DELTA
+
 
 def _softplus_neg(z: jax.Array) -> jax.Array:
     """log(1 + exp(-z)) computed stably as max(0, -z) + log1p(exp(-|z|))."""
@@ -115,13 +117,18 @@ def quadratic_gradient_weighted(
 # Not in the reference — the framework's third objective family: a robust
 # regression between the study's two (quadratic tails hurt under the heavy
 # noise make_regression injects; Huber caps the per-sample gradient at δ‖x‖).
-# δ is fixed at the synthetic data's noise scale (make_regression noise=10.0,
+# δ defaults to the synthetic data's noise scale (make_regression noise=10.0,
 # utils/data.py), i.e. the transition sits at ~1σ of the residuals at the
-# optimum — the classical choice. Closed forms only: the gradient coefficient
-# is clip(r, −δ, δ), smooth everywhere (H_δ is C¹).
+# optimum — the classical choice — and is configurable
+# (``ExperimentConfig.huber_delta``) because it is data-scale-dependent; the
+# single source of the default is config.DEFAULT_HUBER_DELTA. Closed forms
+# only: the gradient coefficient is clip(r, −δ, δ), smooth everywhere
+# (H_δ is C¹).
 # ---------------------------------------------------------------------------
 
-HUBER_DELTA = 10.0
+# Backward-compatible alias; the definition lives in config (jax-free) so the
+# numpy twins and the C-ABI default share it without importing this module.
+HUBER_DELTA = DEFAULT_HUBER_DELTA
 
 
 def _huber(r: jax.Array, delta: float) -> jax.Array:
@@ -129,29 +136,37 @@ def _huber(r: jax.Array, delta: float) -> jax.Array:
     return jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
 
 
-def huber_objective(w: jax.Array, X: jax.Array, y: jax.Array, lam: float) -> jax.Array:
+def huber_objective(
+    w: jax.Array, X: jax.Array, y: jax.Array, lam: float,
+    delta: float = DEFAULT_HUBER_DELTA,
+) -> jax.Array:
     r = X @ w - y
-    return jnp.mean(_huber(r, HUBER_DELTA)) + 0.5 * lam * jnp.dot(w, w)
+    return jnp.mean(_huber(r, delta)) + 0.5 * lam * jnp.dot(w, w)
 
 
-def huber_gradient(w: jax.Array, X: jax.Array, y: jax.Array, lam: float) -> jax.Array:
+def huber_gradient(
+    w: jax.Array, X: jax.Array, y: jax.Array, lam: float,
+    delta: float = DEFAULT_HUBER_DELTA,
+) -> jax.Array:
     r = X @ w - y
-    coeff = jnp.clip(r, -HUBER_DELTA, HUBER_DELTA)
+    coeff = jnp.clip(r, -delta, delta)
     return X.T @ coeff / X.shape[0] + lam * w
 
 
 def huber_objective_weighted(
-    w: jax.Array, X: jax.Array, y: jax.Array, weights: jax.Array, lam: float
+    w: jax.Array, X: jax.Array, y: jax.Array, weights: jax.Array, lam: float,
+    delta: float = DEFAULT_HUBER_DELTA,
 ) -> jax.Array:
     r = X @ w - y
-    return jnp.sum(weights * _huber(r, HUBER_DELTA)) + 0.5 * lam * jnp.dot(w, w)
+    return jnp.sum(weights * _huber(r, delta)) + 0.5 * lam * jnp.dot(w, w)
 
 
 def huber_gradient_weighted(
-    w: jax.Array, X: jax.Array, y: jax.Array, weights: jax.Array, lam: float
+    w: jax.Array, X: jax.Array, y: jax.Array, weights: jax.Array, lam: float,
+    delta: float = DEFAULT_HUBER_DELTA,
 ) -> jax.Array:
     r = X @ w - y
-    coeff = weights * jnp.clip(r, -HUBER_DELTA, HUBER_DELTA)
+    coeff = weights * jnp.clip(r, -delta, delta)
     return X.T @ coeff + lam * w
 
 

@@ -53,24 +53,27 @@ def quadratic_gradient(w, X, y, mu):
     return X.T @ r / X.shape[0] + mu * w
 
 
-HUBER_DELTA = 10.0  # must match ops/losses.py (δ at the noise scale)
+# Single-sourced default δ (config.DEFAULT_HUBER_DELTA); the jax twins in
+# ops/losses.py and the native core's C-ABI argument share the same source.
+from distributed_optimization_tpu.config import DEFAULT_HUBER_DELTA
+
+HUBER_DELTA = DEFAULT_HUBER_DELTA  # backward-compatible alias
 
 
-def huber_objective(w, X, y, lam):
+def huber_objective(w, X, y, lam, delta=DEFAULT_HUBER_DELTA):
     if X.shape[0] == 0:
         return 0.0
     r = X @ w - y
     a = np.abs(r)
-    h = np.where(a <= HUBER_DELTA, 0.5 * r * r,
-                 HUBER_DELTA * (a - 0.5 * HUBER_DELTA))
+    h = np.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
     return float(np.mean(h) + 0.5 * lam * np.dot(w, w))
 
 
-def huber_gradient(w, X, y, lam):
+def huber_gradient(w, X, y, lam, delta=DEFAULT_HUBER_DELTA):
     if X.shape[0] == 0:
         return np.zeros_like(w)
     r = X @ w - y
-    coeff = np.clip(r, -HUBER_DELTA, HUBER_DELTA)
+    coeff = np.clip(r, -delta, delta)
     return X.T @ coeff / X.shape[0] + lam * w
 
 

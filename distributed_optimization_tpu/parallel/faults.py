@@ -29,9 +29,16 @@ collapses to identity. Either way this is the time-varying-graph setting of
 Koloskova et al. '20 (reference report ref [13]): W_t stays symmetric and
 doubly stochastic for every realization, so the network average is preserved
 and D-SGD and DIGing-style gradient tracking remain convergent under their
-time-varying-gossip analyses. EXTRA does NOT compose (its fixed-point
-argument needs a static W — it is rejected alongside ADMM/CHOCO, see
-``Algorithm.supports_edge_faults``).
+time-varying-gossip analyses. For gradient tracking this is not just the
+citation: the tracking invariant mean(y_t) = mean(g_t) survives every fault
+mode because (a) each realized W_t is doubly stochastic and (b) the
+backend's straggler freeze covers ALL state leaves with the frozen node's
+mixing row collapsed to identity — verified numerically to accumulation
+roundoff through the real backend paths
+(tests/test_faults.py::test_gt_tracking_invariant_survives_faults) and
+measured on-chip (examples/bench_faults.py gt_* rows). EXTRA does NOT
+compose (its fixed-point argument needs a static W — it is rejected
+alongside ADMM/CHOCO, see ``Algorithm.supports_edge_faults``).
 
 Fault masks, realized adjacencies, MH weights, and the realized-floats
 accounting are always computed in float32 regardless of the run dtype:

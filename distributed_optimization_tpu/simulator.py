@@ -81,7 +81,8 @@ class Simulator:
             dataset if dataset is not None else generate_synthetic_dataset(base_config)
         )
         self.w_opt, self.f_opt = compute_reference_optimum(
-            self.dataset, base_config.reg_param
+            self.dataset, base_config.reg_param,
+            huber_delta=base_config.huber_delta,
         )
         self.records: list[ExperimentRecord] = []
 

@@ -19,9 +19,19 @@ from distributed_optimization_tpu.utils.data import HostDataset
 
 
 def compute_reference_optimum(
-    dataset: HostDataset, reg_param: float, *, max_iter: int = 50_000, tol: float = 1e-9
+    dataset: HostDataset,
+    reg_param: float,
+    *,
+    max_iter: int = 50_000,
+    tol: float = 1e-9,
+    huber_delta: float | None = None,
 ) -> tuple[np.ndarray, float]:
-    """Return (w_opt [d], f_opt) for the dataset's problem type."""
+    """Return (w_opt [d], f_opt) for the dataset's problem type.
+
+    ``huber_delta`` sets the Huber transition point (huber only; ``None`` =
+    the config default) — the optimum depends on δ, so the oracle must use
+    the same δ as the backends under test.
+    """
     from sklearn.linear_model import LogisticRegression, Ridge
 
     from distributed_optimization_tpu.ops import losses_np
@@ -64,16 +74,25 @@ def compute_reference_optimum(
         # metric definition all backends are judged against anyway).
         from scipy.optimize import minimize
 
+        from distributed_optimization_tpu.config import DEFAULT_HUBER_DELTA
+
+        delta = DEFAULT_HUBER_DELTA if huber_delta is None else float(huber_delta)
         d = dataset.X_full.shape[1]
         res = minimize(
-            lambda w: losses_np.huber_objective(w, dataset.X_full, y, reg_param),
+            lambda w: losses_np.huber_objective(
+                w, dataset.X_full, y, reg_param, delta=delta
+            ),
             np.zeros(d),
-            jac=lambda w: losses_np.huber_gradient(w, dataset.X_full, y, reg_param),
+            jac=lambda w: losses_np.huber_gradient(
+                w, dataset.X_full, y, reg_param, delta=delta
+            ),
             method="L-BFGS-B",
             options={"maxiter": max_iter, "ftol": tol * 1e-2, "gtol": 1e-10},
         )
         w_opt = res.x
-        f_opt = losses_np.huber_objective(w_opt, dataset.X_full, y, reg_param)
+        f_opt = losses_np.huber_objective(
+            w_opt, dataset.X_full, y, reg_param, delta=delta
+        )
     else:
         raise ValueError(f"Unknown problem type: {dataset.problem_type}")
 

@@ -89,8 +89,8 @@ void sample_without_replacement(Xoshiro256ss &rng, int64_t n, int64_t b,
 constexpr int kLogistic = 0;
 constexpr int kQuadratic = 1;
 constexpr int kHuber = 2;
-// Must match ops/losses.py HUBER_DELTA (delta at the regression noise scale).
-constexpr double kHuberDelta = 10.0;
+// The Huber transition point delta is a run_simulation argument (single
+// source: config.DEFAULT_HUBER_DELTA on the Python side) — no baked-in copy.
 
 inline double dot(const double *a, const double *b, int64_t d) {
   double acc = 0.0;
@@ -100,7 +100,8 @@ inline double dot(const double *a, const double *b, int64_t d) {
 
 // Full-dataset objective: mean loss + (reg/2)||w||^2 (losses_np parity).
 double full_objective(int problem, const double *X, const double *y,
-                      int64_t n, int64_t d, const double *w, double reg) {
+                      int64_t n, int64_t d, const double *w, double reg,
+                      double huber_delta) {
   double acc = 0.0;
 #pragma omp parallel for reduction(+ : acc) schedule(static)
   for (int64_t i = 0; i < n; ++i) {
@@ -116,8 +117,8 @@ double full_objective(int problem, const double *X, const double *y,
     } else {  // kHuber
       double r = z - y[i];
       double a = std::fabs(r);
-      acc += a <= kHuberDelta ? 0.5 * r * r
-                              : kHuberDelta * (a - 0.5 * kHuberDelta);
+      acc += a <= huber_delta ? 0.5 * r * r
+                              : huber_delta * (a - 0.5 * huber_delta);
     }
   }
   double obj = acc / static_cast<double>(n);
@@ -128,7 +129,8 @@ double full_objective(int problem, const double *X, const double *y,
 // Stochastic gradient over batch rows `idx` of one worker's shard.
 void stochastic_gradient(int problem, const double *Xs, const double *ys,
                          int64_t d, const std::vector<int64_t> &idx,
-                         const double *w, double reg, double *g_out) {
+                         const double *w, double reg, double huber_delta,
+                         double *g_out) {
   std::memset(g_out, 0, sizeof(double) * d);
   const auto b = static_cast<int64_t>(idx.size());
   if (b == 0) {
@@ -148,8 +150,8 @@ void stochastic_gradient(int problem, const double *Xs, const double *ys,
       coef = z - ys[idx[t]];
     } else {  // kHuber: clip(r, -delta, delta)
       double r = z - ys[idx[t]];
-      coef = r > kHuberDelta ? kHuberDelta
-                             : (r < -kHuberDelta ? -kHuberDelta : r);
+      coef = r > huber_delta ? huber_delta
+                             : (r < -huber_delta ? -huber_delta : r);
     }
     for (int64_t k = 0; k < d; ++k) g_out[k] += coef * xi[k];
   }
@@ -181,6 +183,9 @@ extern "C" {
 //            lower index (a stable descending sort — matches lax.top_k and
 //            the numpy oracle);
 // sqrt_decay: 1 = eta0/sqrt(t+1), 0 = constant eta0;
+// huber_delta: Huber transition point (problem 2 only; must be > 0) — the
+//            caller passes config.huber_delta so all three tiers share one
+//            source (config.DEFAULT_HUBER_DELTA is the default);
 // out_models: [n_workers, d] final per-worker models (centralized: rows equal);
 // collect_metrics: 0 skips all objective/consensus evaluation (pure
 //            iteration throughput; out_gap/out_cons left untouched);
@@ -196,7 +201,8 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
                    int64_t n_workers, int64_t d, const double *W,
                    int algorithm, int problem, int64_t T,
                    int64_t batch_size, double eta0, int sqrt_decay,
-                   double reg, double admm_c, double admm_rho,
+                   double reg, double huber_delta,
+                   double admm_c, double admm_rho,
                    int compression, int64_t comp_k, double choco_gamma,
                    uint64_t seed,
                    int64_t eval_every, int collect_metrics,
@@ -209,6 +215,7 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
     return 1;
   }
   if (problem < kLogistic || problem > kHuber) return 2;
+  if (problem == kHuber && huber_delta <= 0.0) return 2;
   if (algorithm < kCentralized || algorithm > kChoco) return 3;
   if (algorithm == kAdmm && (admm_c <= 0.0 || admm_rho <= 0.0)) return 4;
   if (algorithm == kChoco &&
@@ -279,6 +286,7 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
         }
         const double *params = shared ? at : at + i * d;
         stochastic_gradient(problem, X + lo * d, y + lo, d, idx, params, reg,
+                            huber_delta,
                             grads.data() + i * d);
       }
     }
@@ -450,14 +458,16 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       if (!collect_metrics) {
         // objective/consensus evaluation skipped; timestamp still stamped
       } else if (centralized) {
-        out_gap[row] = full_objective(problem, X, y, n_total, d, models.data(), reg);
+        out_gap[row] = full_objective(problem, X, y, n_total, d,
+                                      models.data(), reg, huber_delta);
       } else {  // decentralized metrics
         std::memset(avg.data(), 0, sizeof(double) * d);
         for (int64_t i = 0; i < n_workers; ++i)
           for (int64_t k = 0; k < d; ++k) avg[k] += models[i * d + k];
         const double inv_n = 1.0 / static_cast<double>(n_workers);
         for (int64_t k = 0; k < d; ++k) avg[k] *= inv_n;
-        out_gap[row] = full_objective(problem, X, y, n_total, d, avg.data(), reg);
+        out_gap[row] = full_objective(problem, X, y, n_total, d,
+                                      avg.data(), reg, huber_delta);
         double ce = 0.0;
         for (int64_t i = 0; i < n_workers; ++i) {
           const double *xi = models.data() + i * d;

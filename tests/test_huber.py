@@ -181,6 +181,56 @@ def test_exact_methods_pin_oracle_where_dsgd_stalls(huber_setup, algorithm,
     assert dsgd.history.consensus_error[-1] > 1e-3
 
 
+def test_non_default_delta_is_single_sourced_across_tiers():
+    """config.huber_delta=2.5 threads through ALL THREE tiers: jax, numpy,
+    and C++ full-batch runs agree to fp tolerance at the non-default δ, the
+    oracle solves the δ=2.5 objective, and the trajectory genuinely differs
+    from the default-δ one (the knob is live). Guards against the cross-tier
+    drift hazard of a re-introduced hard-coded copy."""
+    delta = 2.5
+    cfg = small_backend_config(
+        problem_type="huber", huber_delta=delta, n_iterations=300,
+        local_batch_size=50, lr_schedule="constant",
+        learning_rate_eta0=0.02, eval_every=30, dtype="float64",
+    )
+    ds = generate_synthetic_dataset(cfg)
+    w_opt, f_opt = compute_reference_optimum(
+        ds, cfg.reg_param, huber_delta=delta
+    )
+    # Oracle stationarity AT δ=2.5 (wrong-δ gradients are not ~0 there).
+    g = losses_np.huber_gradient(w_opt, ds.X_full, ds.y_full, cfg.reg_param,
+                                 delta=delta)
+    assert np.linalg.norm(g) < 1e-5
+    g_default = losses_np.huber_gradient(w_opt, ds.X_full, ds.y_full,
+                                         cfg.reg_param)
+    assert np.linalg.norm(g_default) > 1e-2
+
+    rj = run_algorithm(cfg, ds, f_opt)
+    rn = run_algorithm(cfg.replace(backend="numpy"), ds, f_opt)
+    # jax and numpy sum in different orders; float64 agreement to ~1e-6 is
+    # the same standard the injected-batch equivalence tests use.
+    np.testing.assert_allclose(rj.final_models, rn.final_models,
+                               rtol=1e-6, atol=1e-6)
+
+    # δ must actually change the trajectory.
+    rn_default = run_algorithm(
+        cfg.replace(backend="numpy", huber_delta=10.0), ds, f_opt
+    )
+    assert np.abs(rn.final_models - rn_default.final_models).max() > 1e-3
+
+    cpp_backend = pytest.importorskip(
+        "distributed_optimization_tpu.backends.cpp_backend")
+    try:
+        cpp_backend.load_library()
+    except cpp_backend.NativeBuildError:
+        pytest.skip("native toolchain unavailable")
+    rc = cpp_backend.run(cfg, ds, f_opt)
+    np.testing.assert_allclose(rc.final_models, rn.final_models,
+                               rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(rc.history.objective, rn.history.objective,
+                               rtol=1e-7, atol=1e-9)
+
+
 def test_cli_runs_huber(tmp_path):
     import json
 
