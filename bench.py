@@ -1,14 +1,32 @@
 """Headline benchmark: the BASELINE.json north-star configuration.
 
-Protocol (round 3 — VERDICT r2 item 1): the shared tunneled chip swings
-2-3x with co-tenant load, so the jax headline and the CPU baseline are
-measured INTERLEAVED — five cycles, each one numpy-simulator segment
-followed by one full jax run — and the reported value is the MEDIAN of the
-five jax measurements over the MEDIAN of the five numpy measurements, with
-the spreads printed alongside. Sequential best-of-N (the round-1/2
-protocol) let the two sides sample different chip/host windows and made the
-ratio the product of two noisy extremes; medians of interleaved samples
-gate out exactly that.
+Protocol (round 4 — VERDICT r3 item 1): two changes over the round-3
+interleaved median-of-5 protocol.
+
+1. **Amortized horizon.** The throughput cycles run T=300,000 (round 3 ran
+   T=30,000). At T=30k the fixed per-run overhead (~240 ms of tunnel /
+   dispatch / host sync against ~164 ms of device time — ROUND3_NOTES
+   "Headline amortization") ate ~60% of the measured wall-clock, so the
+   published number undersold steady-state throughput ~2× and inherited the
+   full variance of the overhead term (the round-3 published range 634–1,223×
+   failed to contain the round-3 driver capture of 470×). At T=300k the
+   overhead is <10% of wall-clock; same-session spread measured ~11% at the
+   protocol change (vs ~1.7–1.9× at T=30k). The eval cadence stays
+   eval_every=1 — the SAME per-iteration full-dataset objective eval the
+   reference performs (reference ``trainer.py:189``) and the numpy baseline
+   pays, so the comparison stays apples-to-apples.
+
+2. **Self-validating range.** The published headline range now lives in ONE
+   committed artifact — ``docs/perf/headline_sessions.json`` — that the docs
+   cite and this script LOADS AND ENFORCES: if the measured median lands
+   outside ``published_range_ips``, the bench fails loudly instead of letting
+   the docs go silently stale (which happened three rounds running). Widening
+   the range is a deliberate, committed act, never a drift.
+
+Interleaving (unchanged from round 3): the shared tunneled chip swings with
+co-tenant load, so each of the five cycles pairs one numpy-simulator segment
+with one full jax run, and the reported value is the MEDIAN of the five jax
+measurements over the MEDIAN of the five numpy measurements.
 
 Two measurements, one JSON line:
 
@@ -20,12 +38,9 @@ Two measurements, one JSON line:
 
 2. **Headline** (stdout JSON): the north-star scale config named in
    BASELINE.json — 256-worker decentralized logistic regression on a ring —
-   at T=30,000, a horizon the run actually CROSSES the study's ε ≤ 0.08
-   threshold within (measured crossing ≈ iteration 25k,
-   docs/perf/northstar_consensus.json; the round-2 T=10k headline ended at
-   gap 0.113 > ε, which made "throughput of a converging run" an
-   extrapolation). Gates: finite metrics, the ε-crossing itself, and
-   bounded consensus.
+   at T=300,000, a horizon the run crosses the study's ε ≤ 0.08 threshold
+   well within (measured crossing ≈ iteration 22.5k). Gates: finite metrics,
+   the ε-crossing itself, bounded consensus, and the published-range check.
 
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": ..., "unit": "iters/sec", "vs_baseline": ...}
@@ -34,9 +49,12 @@ Prints exactly ONE JSON line on stdout:
 from __future__ import annotations
 
 import json
+import pathlib
 import statistics
 import sys
 import time
+
+_SESSIONS_ARTIFACT = pathlib.Path(__file__).parent / "docs/perf/headline_sessions.json"
 
 
 def main() -> None:
@@ -48,10 +66,36 @@ def main() -> None:
     from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
     from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
 
-    # --- 1. reference-parity convergence check (N=25, published config) ---
+    # The two configs of the protocol: the reference-parity check and the
+    # headline. The headline cfg is built ONCE here and used for both the
+    # artifact pre-flight below and the measured run, so they cannot drift.
     parity_cfg = ExperimentConfig(
         problem_type="logistic", algorithm="dsgd", topology="ring"
     )  # reference defaults: N=25, T=10000, b=16, eta0=0.05, lambda=1e-4
+    cfg = parity_cfg.replace(n_workers=256, n_iterations=300_000)
+
+    # Validate the published-range artifact BEFORE any chip work: a stale
+    # metric name or malformed range must not cost a full benchmark session.
+    published = json.loads(_SESSIONS_ARTIFACT.read_text())
+    if published.get("metric") != _metric_name(cfg):
+        raise SystemExit(
+            f"headline_sessions.json records metric {published.get('metric')!r} "
+            f"but this bench measures {_metric_name(cfg)!r} — "
+            "update the artifact to the current protocol"
+        )
+    try:
+        lo, hi = (float(x) for x in published["published_range_ips"])
+        floor_ratio = float(published["published_floor_ratio_vs_numpy"])
+        if not (0 < lo < hi):
+            raise ValueError(f"empty or inverted range [{lo}, {hi}]")
+    except (KeyError, TypeError, ValueError) as e:
+        raise SystemExit(
+            f"headline_sessions.json is malformed ({e!r}) — it must carry "
+            "published_range_ips=[lo, hi] (numeric, lo < hi) and "
+            "published_floor_ratio_vs_numpy"
+        )
+
+    # --- 1. reference-parity convergence check (N=25, published config) ---
     t0 = time.perf_counter()
     parity_ds = generate_synthetic_dataset(parity_cfg)
     _, parity_f_opt = compute_reference_optimum(parity_ds, parity_cfg.reg_param)
@@ -75,15 +119,15 @@ def main() -> None:
         )
 
     # --- 2. north-star scale config: N=256 decentralized logistic ---
-    # T=30k crosses the study's ε ≤ 0.08 within the horizon (≈ iter 25k).
-    cfg = parity_cfg.replace(n_workers=256, n_iterations=30_000)
+    # T=300k amortizes fixed per-run overhead to <10% of wall-clock; the run
+    # crosses the study's ε ≤ 0.08 within the horizon (≈ iter 22.5k).
     ds = generate_synthetic_dataset(cfg)
     _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
 
     # Interleaved median-of-5: numpy segment, then jax run, x5. The numpy
     # simulator is steady-state (same per-iteration work every iteration),
     # so a 400-iteration segment per cycle samples its rate honestly; the
-    # jax run is the full T=30k workload. Each run() call re-traces and
+    # jax run is the full T=300k workload. Each run() call re-traces and
     # re-compiles (the jit cache is keyed on the per-call closures), so the
     # persistent compilation cache is enabled first: the warmup run pays
     # the XLA compile once and every measured cycle deserializes it in
@@ -123,7 +167,7 @@ def main() -> None:
     jax_median = statistics.median(jax_ips)
     numpy_median = statistics.median(numpy_ips)
     print(
-        f"[bench] N=256 T=30k jax: median {jax_median:.0f} iters/sec "
+        f"[bench] N=256 T=300k jax: median {jax_median:.0f} iters/sec "
         f"(spread {min(jax_ips):.0f}-{max(jax_ips):.0f}); numpy "
         f"reference-semantics: median {numpy_median:.1f} "
         f"(spread {min(numpy_ips):.1f}-{max(numpy_ips):.1f}); compile "
@@ -152,9 +196,9 @@ def main() -> None:
         file=sys.stderr,
     )
     # Consensus must stay bounded (gossip contraction active). The N=256
-    # ring's consensus is still in its slow ~1/t phase at T=30k (spectral
-    # gap 2e-4); boundedness, not a small absolute value, is the honest
-    # gate here (see docs/PERF.md §2 for the full consensus story).
+    # ring's consensus is still in its slow ~1/t phase at this horizon
+    # (spectral gap 2e-4); boundedness, not a small absolute value, is the
+    # honest gate here (see docs/PERF.md §2 for the full consensus story).
     cons = hist.consensus_error
     if not (np.all(np.isfinite(cons)) and cons[-1] < 1.0):
         raise SystemExit(
@@ -162,15 +206,57 @@ def main() -> None:
             f"throughput (consensus {cons[0]:.3e} -> {cons[-1]:.3e})"
         )
 
+    # --- 3. self-check against the PUBLISHED range (VERDICT r3 item 1b) ---
+    # The range the docs quote lives in docs/perf/headline_sessions.json and
+    # is enforced here: a capture outside it means either the chip regressed
+    # /improved beyond every recorded session or the docs are stale — both
+    # demand a committed, deliberate range update, not silent drift.
+    session_line = {
+        "jax_median_ips": round(jax_median, 2),
+        "jax_cycles_ips": [round(x, 2) for x in jax_ips],
+        "numpy_median_ips": round(numpy_median, 2),
+        "ratio": round(jax_median / numpy_median, 2),
+    }
+    print(f"[bench] session record: {json.dumps(session_line)}", file=sys.stderr)
+    if not (lo <= jax_median <= hi):
+        raise SystemExit(
+            f"measured median {jax_median:.0f} iters/sec is OUTSIDE the "
+            f"published range [{lo}, {hi}] from {_SESSIONS_ARTIFACT.name} — "
+            "the published claim no longer contains reality. Append the "
+            "session record above to the artifact, widen published_range_ips "
+            "to contain every recorded session, and update the docs that "
+            "cite it (docs/PERF.md, README.md, docs/ARCHITECTURE.md)."
+        )
+    if jax_median / numpy_median < floor_ratio:
+        raise SystemExit(
+            f"measured ratio {jax_median / numpy_median:.0f}x vs the "
+            f"same-session numpy baseline is below the published floor "
+            f"({floor_ratio:.0f}x, {_SESSIONS_ARTIFACT.name}) — the docs' "
+            "ratio claims no longer contain reality; re-derive them in a "
+            "commit"
+        )
+    print(
+        f"[bench] self-check OK: median inside published range [{lo}, {hi}], "
+        f"ratio above {floor_ratio:.0f}x floor",
+        file=sys.stderr,
+    )
+
     print(
         json.dumps(
             {
-                "metric": "dsgd_ring_logistic_N256_T30k_iters_per_sec_median5",
+                "metric": _metric_name(cfg),
                 "value": round(jax_median, 2),
                 "unit": "iters/sec",
                 "vs_baseline": round(jax_median / numpy_median, 2),
             }
         )
+    )
+
+
+def _metric_name(cfg) -> str:
+    return (
+        f"dsgd_ring_logistic_N{cfg.n_workers}_T{cfg.n_iterations // 1000}k"
+        "_iters_per_sec_median5"
     )
 
 

@@ -1,0 +1,160 @@
+"""The headline bench's published-range self-check (round 4).
+
+`bench.py` loads `docs/perf/headline_sessions.json` and refuses to report a
+median that lands outside `published_range_ips` — the mechanism that keeps
+the docs' headline claim from going silently stale (VERDICT r3 item 1b:
+the round-3 published range failed to contain the round-3 driver capture).
+These tests drive both branches with stubbed backends so the self-check
+logic itself is pinned without chip time.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+import bench  # noqa: E402
+
+from distributed_optimization_tpu.backends import jax_backend, numpy_backend
+from distributed_optimization_tpu.backends.base import BackendRunResult
+from distributed_optimization_tpu.metrics import RunHistory
+from distributed_optimization_tpu.utils import data as data_mod
+from distributed_optimization_tpu.utils import oracle as oracle_mod
+
+
+def _fake_result(config, ips: float) -> BackendRunResult:
+    T = config.n_iterations
+    n_rows = min(T, 64)  # decaying gap that crosses ε=0.08 within the run
+    objective = np.geomspace(0.5, 0.01, n_rows)
+    hist = RunHistory(
+        objective=objective,
+        consensus_error=np.geomspace(1e-1, 1e-2, n_rows),
+        time=np.linspace(0.0, T / ips, n_rows),
+        eval_iterations=np.linspace(1, T, n_rows).astype(int),
+        total_floats_transmitted=2.0 * config.n_workers * 81 * T,
+        iters_per_second=ips,
+        compile_seconds=0.1,
+    )
+    models = np.zeros((config.n_workers, 81))
+    return BackendRunResult(hist, models, models.mean(axis=0))
+
+
+@pytest.fixture
+def stubbed(monkeypatch, tmp_path):
+    """Stub every expensive call bench.main makes; yield a mutable dict whose
+    'jax_ips' entry controls the measured median, plus the artifact path."""
+    knobs = {"jax_ips": 100_000.0}
+
+    class _DS:  # bench only threads the dataset through to the backends
+        pass
+
+    monkeypatch.setattr(data_mod, "generate_synthetic_dataset", lambda cfg: _DS())
+    monkeypatch.setattr(
+        oracle_mod, "compute_reference_optimum",
+        lambda ds, reg: (np.zeros(81), 0.1),
+    )
+    monkeypatch.setattr(
+        jax_backend, "run",
+        lambda cfg, ds, f_opt, **kw: _fake_result(cfg, knobs["jax_ips"]),
+    )
+    monkeypatch.setattr(
+        numpy_backend, "run",
+        lambda cfg, ds, f_opt, **kw: _fake_result(cfg, 90.0),
+    )
+
+    artifact = tmp_path / "headline_sessions.json"
+    artifact.write_text(json.dumps({
+        "metric": "dsgd_ring_logistic_N256_T300k_iters_per_sec_median5",
+        "published_range_ips": [65_000, 175_000],
+        "published_floor_ratio_vs_numpy": 500,
+    }))
+    monkeypatch.setattr(bench, "_SESSIONS_ARTIFACT", artifact)
+    return knobs, artifact
+
+
+def test_in_range_prints_json_line(stubbed, capsys):
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "bench must print exactly one stdout line"
+    payload = json.loads(out[0])
+    assert payload["metric"] == "dsgd_ring_logistic_N256_T300k_iters_per_sec_median5"
+    assert payload["value"] == 100_000.0
+    assert payload["unit"] == "iters/sec"
+    assert payload["vs_baseline"] == pytest.approx(100_000.0 / 90.0, rel=1e-3)
+
+
+@pytest.mark.parametrize("ips", [40_000.0, 200_000.0])
+def test_out_of_range_fails_loudly(stubbed, capsys, ips):
+    knobs, _ = stubbed
+    knobs["jax_ips"] = ips
+    with pytest.raises(SystemExit, match="OUTSIDE the published range"):
+        bench.main()
+    assert capsys.readouterr().out.strip() == "", (
+        "an out-of-range capture must not emit the stdout JSON line"
+    )
+
+
+def test_ratio_below_published_floor_fails_loudly(stubbed, capsys):
+    """The ratio floor guards the docs' 'x the CPU baseline' claims even when
+    the absolute median stays in range (e.g. the numpy host speeds up)."""
+    knobs, _ = stubbed
+    knobs["jax_ips"] = 66_000.0  # in range, but 66k/90 ≈ 733 — drop the floor
+    _, artifact = stubbed
+    payload = json.loads(artifact.read_text())
+    payload["published_floor_ratio_vs_numpy"] = 1000
+    artifact.write_text(json.dumps(payload))
+    with pytest.raises(SystemExit, match="below the published floor"):
+        bench.main()
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_malformed_artifact_fails_before_any_measurement(stubbed, monkeypatch):
+    """A malformed artifact must die instantly, not after chip cycles."""
+    knobs, artifact = stubbed
+    payload = json.loads(artifact.read_text())
+    del payload["published_range_ips"]
+    artifact.write_text(json.dumps(payload))
+
+    def _boom(*a, **kw):
+        raise AssertionError("backend ran despite a malformed artifact")
+
+    monkeypatch.setattr(jax_backend, "run", _boom)
+    monkeypatch.setattr(numpy_backend, "run", _boom)
+    with pytest.raises(SystemExit, match="malformed"):
+        bench.main()
+
+
+def test_metric_rename_requires_artifact_update(stubbed):
+    """If the protocol changes (metric name drifts from the artifact), the
+    bench refuses rather than validating against a stale range."""
+    _, artifact = stubbed
+    payload = json.loads(artifact.read_text())
+    payload["metric"] = "dsgd_ring_logistic_N256_T30k_iters_per_sec_median5"
+    artifact.write_text(json.dumps(payload))
+    with pytest.raises(SystemExit, match="update the.*artifact|artifact to the current"):
+        bench.main()
+
+
+def test_committed_artifact_is_consistent():
+    """The real committed artifact: range contains every recorded T=300k
+    session median, and the metric matches what bench.py measures."""
+    published = json.loads(bench._SESSIONS_ARTIFACT.read_text())
+    lo, hi = published["published_range_ips"]
+    assert lo < hi
+    assert published["published_floor_ratio_vs_numpy"] > 0
+    sessions = published["sessions_t300k"]
+    assert sessions, "at least one recorded session"
+    for s in sessions:
+        assert lo <= s["jax_median_ips"] <= hi, (
+            f"recorded session {s['source']!r} escapes the published range"
+        )
+    from distributed_optimization_tpu.config import ExperimentConfig
+    cfg = ExperimentConfig(
+        problem_type="logistic", algorithm="dsgd", topology="ring",
+        n_workers=256, n_iterations=300_000,
+    )
+    assert published["metric"] == bench._metric_name(cfg)
