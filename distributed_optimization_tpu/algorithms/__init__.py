@@ -1,4 +1,5 @@
 """Optimization algorithms: centralized SGD, D-SGD, gradient tracking, EXTRA,
-decentralized (linearized) ADMM — as pure, jittable step rules."""
+decentralized (linearized) ADMM, CHOCO-SGD, and push-sum SGP — as pure,
+jittable step rules."""
 
 from distributed_optimization_tpu.algorithms.base import Algorithm, get_algorithm  # noqa: F401

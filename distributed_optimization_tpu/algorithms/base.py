@@ -104,6 +104,7 @@ def get_algorithm(name: str) -> Algorithm:
         dsgd,
         extra,
         gradient_tracking,
+        push_sum,
     )
 
     if name not in _REGISTRY:

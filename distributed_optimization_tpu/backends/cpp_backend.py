@@ -4,9 +4,9 @@ The framework's native runtime tier for host-side execution: the reference's
 two algorithms (centralized SGD and D-SGD with a dense mixing matrix —
 reference ``trainer.py:7-74``/``76-197``) plus matrix/node-form recursions
 of the extensions (DIGing gradient tracking, EXTRA, DLM decentralized ADMM,
-and CHOCO-SGD with deterministic compressors — the same recursions the
-numpy oracle implements, giving a third independent implementation for
-cross-tier verification), compiled from
+CHOCO-SGD with deterministic compressors, and push-sum SGP over directed
+graphs — the same recursions the numpy oracle implements, giving a third
+independent implementation for cross-tier verification), compiled from
 ``native/src/gossip_core.cpp`` into a shared library (OpenMP-parallel
 worker loop, stable closed-form objectives). Fidelity-sensitive work stays on
 the numpy oracle (exact reference semantics, injectable batches); this tier
@@ -38,9 +38,9 @@ from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.utils.data import HostDataset
 
 _SUPPORTED = ("centralized", "dsgd", "gradient_tracking", "extra", "admm",
-              "choco")
+              "choco", "push_sum")
 _ALGO_CODES = {"centralized": 0, "dsgd": 1, "gradient_tracking": 2,
-               "extra": 3, "admm": 4, "choco": 5}
+               "extra": 3, "admm": 4, "choco": 5, "push_sum": 6}
 _COMPRESSION_CODES = {"none": 0, "top_k": 1}
 
 _REPO_ROOT = os.path.dirname(

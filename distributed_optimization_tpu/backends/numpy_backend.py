@@ -16,9 +16,10 @@ INDEPENDENT matrix-form host implementations of every extension written
 directly from the published recursions rather than through the shared
 ``Algorithm.step`` rules — gradient tracking (Nedić-Olshevsky-Shi 2017,
 DIGing), EXTRA (Shi-Ling-Wu-Yin 2015 eq. 2.13), decentralized linearized
-ADMM (Ling-Shi-Wu-Ribeiro 2015, DLM; half-Laplacian matrix form), and
-CHOCO-SGD (Koloskova-Stich-Jaggi 2019, Algorithm 2 matrix form) — so all
-six algorithms have a long-horizon fixed-point / trajectory oracle for the
+ADMM (Ling-Shi-Wu-Ribeiro 2015, DLM; half-Laplacian matrix form), CHOCO-SGD
+(Koloskova-Stich-Jaggi 2019, Algorithm 2 matrix form), and push-sum SGP
+(Nedić-Olshevsky 2016; Assran et al. 2019, Algorithm 1) — so all seven
+algorithms have a long-horizon fixed-point / trajectory oracle for the
 JAX backend (SURVEY.md §4c backend-equivalence strategy). The only CHOCO
 restriction: randomized compressors (random_k, qsgd) draw from the JAX
 counter-based PRNG inside the step, which a host oracle cannot reproduce
@@ -48,12 +49,13 @@ from distributed_optimization_tpu.parallel import build_topology
 from distributed_optimization_tpu.utils.data import HostDataset
 
 _SUPPORTED = (
-    "centralized", "dsgd", "gradient_tracking", "extra", "admm", "choco"
+    "centralized", "dsgd", "gradient_tracking", "extra", "admm", "choco",
+    "push_sum",
 )
 
 # Algorithms with a dedicated matrix-form host implementation below,
 # independent of the shared ``Algorithm.step`` rules the JAX backend runs.
-_MATRIX_FORM = ("gradient_tracking", "extra", "admm", "choco")
+_MATRIX_FORM = ("gradient_tracking", "extra", "admm", "choco", "push_sum")
 
 
 def _topk_rows(v: np.ndarray, k: int) -> np.ndarray:
@@ -232,6 +234,25 @@ def run(
                     rho * x + c_pen * (L_plus @ x) - g - phi
                 )
                 return {"x": x_new, "phi": phi + c_pen * (L_minus @ x_new)}
+
+        elif config.algorithm == "push_sum":
+            # Push-sum SGP (Nedić-Olshevsky 2016; Assran et al. 2019 Alg. 1)
+            # with COLUMN-stochastic A (directed graphs; a doubly stochastic
+            # W is the degenerate case with mass ≡ 1):
+            #   num_{t+1} = A (num_t − η ∇F(z_t))
+            #   w_{t+1}   = A w_t,  w_0 = 1
+            #   z_{t+1}   = num_{t+1} / w_{t+1}
+            # Gradients at the de-biased z. The 'x' leaf holds z so metrics
+            # and final_models see the estimates (same layout as the jax
+            # rule). Columns of A summing to 1 conserve Σ num and Σ w = N.
+            state = {"x": zeros.copy(), "num": zeros.copy(),
+                     "w": np.ones((n, 1))}
+
+            def matrix_step(state, t, eta, grad_at):
+                g = grad_at(state["x"])
+                num_new = W @ (state["num"] - eta * g)
+                w_new = W @ state["w"]
+                return {"x": num_new / w_new, "num": num_new, "w": w_new}
 
         else:  # choco
             # CHOCO-SGD (Koloskova et al. 2019, Algorithm 2 matrix form):

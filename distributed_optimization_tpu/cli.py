@@ -62,6 +62,13 @@ PRESETS: dict[str, dict] = {
     # but statistically degenerate. docs/perf/presets.json measures both.
     "digits-64": dict(problem_type="logistic", algorithm="dsgd",
                       topology="ring", n_workers=64, dataset="digits"),
+    # 6. Push-sum SGP, logistic, 16-worker strongly connected DIRECTED
+    # Erdős–Rényi graph (round 4; beyond BASELINE.json) — the asymmetric-
+    # link setting where MH gossip is undefined and column-stochastic
+    # mixing + weight debiasing is required (Nedić-Olshevsky '16, Assran
+    # et al. '19). Measured in docs/perf/presets.json like the others.
+    "push-sum-der-16": dict(problem_type="logistic", algorithm="push_sum",
+                            topology="directed_erdos_renyi", n_workers=16),
 }
 
 

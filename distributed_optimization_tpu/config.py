@@ -16,10 +16,21 @@ from typing import Any
 
 # Algorithms the framework implements. The reference only has 'centralized'
 # (reference trainer.py:7-74) and 'dsgd' (trainer.py:76-197); the rest are the
-# planned capability extensions named in BASELINE.json.
-ALGORITHMS = ("centralized", "dsgd", "gradient_tracking", "extra", "admm", "choco")
+# planned capability extensions named in BASELINE.json, plus push_sum (SGP —
+# stochastic gradient push over directed graphs, Nedić-Olshevsky 2016 /
+# Assran et al. 2019), the asymmetric-link continuation of the reference's
+# MH-gossip family (reference trainer.py:118-126 builds the symmetric case).
+ALGORITHMS = ("centralized", "dsgd", "gradient_tracking", "extra", "admm",
+              "choco", "push_sum")
 
-TOPOLOGIES = ("ring", "grid", "fully_connected", "erdos_renyi", "chain", "star")
+TOPOLOGIES = ("ring", "grid", "fully_connected", "erdos_renyi", "chain", "star",
+              "directed_ring", "directed_erdos_renyi")
+
+# Directed topologies carry column-stochastic (not doubly stochastic) mixing:
+# plain gossip algorithms would drift toward the graph's Perron weighting
+# instead of the true average, so only push_sum — which debiases by the
+# tracked mass — may run on them.
+DIRECTED_TOPOLOGIES = ("directed_ring", "directed_erdos_renyi")
 
 PROBLEM_TYPES = ("logistic", "quadratic", "huber")
 
@@ -210,6 +221,18 @@ class ExperimentConfig:
                 raise ValueError(
                     f"grid topology requires a perfect-square worker count, got {self.n_workers}"
                 )
+        if (
+            self.topology in DIRECTED_TOPOLOGIES
+            and self.algorithm != "push_sum"
+        ):
+            raise ValueError(
+                f"topology {self.topology!r} is directed: its mixing matrix "
+                "is column-stochastic, not doubly stochastic, so "
+                f"{self.algorithm!r} would converge to the graph's Perron "
+                "weighting instead of the true average — use "
+                "algorithm='push_sum', which debiases by the tracked "
+                "push-sum mass"
+            )
 
     def resolved_sampling_impl(self, platform: str, n_local: int) -> str:
         """Resolve sampling_impl='auto' from measured data.
@@ -238,8 +261,13 @@ class ExperimentConfig:
     def resolved_lr_schedule(self) -> str:
         if self.lr_schedule != "auto":
             return self.lr_schedule
+        # SGD-family rules (plain stochastic gossip descent, incl. SGP's
+        # gradient-push) take the reference's decaying step; the
+        # bias-corrected / dual methods run their constant-step regimes.
         return (
-            "sqrt_decay" if self.algorithm in ("centralized", "dsgd") else "constant"
+            "sqrt_decay"
+            if self.algorithm in ("centralized", "dsgd", "push_sum")
+            else "constant"
         )
 
     # The regularizer actually used for the gradient/objective: the reference

@@ -54,7 +54,7 @@ class MixingOp:
 def _supports_stencil(topo: Topology) -> bool:
     if topo.name == "fully_connected":
         return True
-    if topo.name == "ring":
+    if topo.name in ("ring", "directed_ring"):
         return topo.n >= 3
     if topo.name == "grid":
         return topo.grid_shape is not None and min(topo.grid_shape) >= 3
@@ -135,6 +135,19 @@ def make_mixing_op(topo: Topology, impl: str = "auto", dtype=jnp.float32) -> Mix
 
         def neighbor_sum(x: jax.Array) -> jax.Array:
             return (jnp.roll(x, 1, axis=0) + jnp.roll(x, -1, axis=0)).astype(x.dtype)
+
+        return MixingOp(topo.name, "stencil", apply, neighbor_sum)
+
+    if topo.name == "directed_ring":
+        # Out-degree 1 everywhere ⇒ column-stochastic weights are 1/2 on the
+        # self-loop and the forward edge: (Ax)_i = (x_i + x_{i-1})/2. ONE
+        # roll — when sharded this is a single forward CollectivePermute per
+        # round, half the undirected ring's boundary traffic.
+        def apply(x: jax.Array) -> jax.Array:
+            return (0.5 * (x + jnp.roll(x, 1, axis=0))).astype(x.dtype)
+
+        def neighbor_sum(x: jax.Array) -> jax.Array:
+            return jnp.roll(x, 1, axis=0).astype(x.dtype)
 
         return MixingOp(topo.name, "stencil", apply, neighbor_sum)
 

@@ -14,6 +14,16 @@ mixing step maps onto TPU ICI as `ppermute` neighbor shifts instead of a dense
 ``W @ models`` matmul — ring/chain/torus are the cases where the communication
 graph embeds directly into the pod mesh.
 
+Round 4 adds DIRECTED graphs (``directed_ring``, ``directed_erdos_renyi``)
+with column-stochastic uniform-out-weight mixing — the push-sum/SGP setting
+(Nedić-Olshevsky 2016; Assran et al. 2019), where Metropolis-Hastings gossip
+is undefined because asymmetric links admit no symmetric doubly stochastic
+weight assignment. Convention: ``adjacency[i, j] = 1`` iff j sends to i
+(row i = who i RECEIVES from), so ``mixing_matrix @ x`` aggregates received
+messages for both directed and undirected graphs. The directed ring is the
+ICI-friendly case: one gossip round is a single forward ``ppermute`` — half
+the undirected ring's boundary traffic.
+
 This module is host-side (numpy): topologies are built once per run, outside
 ``jit``. The compiled mixing operators that consume them live in
 ``ops/mixing.py`` and ``parallel/collectives.py``.
@@ -30,14 +40,24 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
-    """An undirected communication graph plus its gossip structure."""
+    """A communication graph plus its gossip structure.
+
+    Undirected graphs (``directed=False``) carry a Metropolis-Hastings
+    mixing matrix (row-stochastic, symmetric — hence doubly stochastic);
+    directed graphs carry a column-stochastic uniform-out-weight matrix
+    (each node splits its mass equally over its out-neighbors and itself),
+    the push-sum setting. ``adjacency[i, j] = 1`` iff j sends to i.
+    """
 
     name: str
     n: int
-    adjacency: np.ndarray  # [N, N] 0/1, zero diagonal
+    adjacency: np.ndarray  # [N, N] 0/1, zero diagonal; row i = i's in-edges
+    # Out-degrees (== in-degrees for undirected graphs): how many neighbors
+    # each node TRANSMITS to per gossip round — the comms-accounting side.
     degrees: np.ndarray  # [N]
-    mixing_matrix: np.ndarray  # [N, N] Metropolis-Hastings, row-stochastic, symmetric
+    mixing_matrix: np.ndarray  # [N, N]; MH (undirected) or column-stochastic
     grid_shape: Optional[tuple[int, int]] = None  # set for 'grid'
+    directed: bool = False
 
     @property
     def spectral_gap(self) -> float:
@@ -45,10 +65,17 @@ class Topology:
 
         Parity: reference trainer.py:133-135. Closed-form values for the
         report setup: ring(25) ≈ 0.0209, 5x5 torus ≈ 0.2764, fc = 1.0.
+        Directed mixing matrices are non-normal with a possibly complex
+        spectrum; ρ is the second-largest eigenvalue MODULUS (the
+        ergodicity coefficient of the column-stochastic chain — self-loops
+        make it primitive, so ρ < 1 for strongly connected graphs).
         """
         if self.n < 2:
             return 1.0
-        eigs = np.sort(np.abs(np.linalg.eigvalsh(self.mixing_matrix)))
+        if self.directed:
+            eigs = np.sort(np.abs(np.linalg.eigvals(self.mixing_matrix)))
+        else:
+            eigs = np.sort(np.abs(np.linalg.eigvalsh(self.mixing_matrix)))
         return float(1.0 - eigs[-2])
 
     @property
@@ -57,19 +84,32 @@ class Topology:
 
         One gossip round sends each worker's model to each of its neighbors:
         Σ_i deg_i values per model coordinate (reference trainer.py:169-170).
-        Multiply by d (and by rounds-per-iteration for two-mix algorithms).
+        For directed graphs deg = out-degree, so the sum counts each directed
+        edge once. Multiply by d (and by rounds-per-iteration for two-mix
+        algorithms).
         """
         return float(np.sum(self.degrees))
 
     def validate(self) -> None:
-        """Invariant checks (parity: reference trainer.py:128-131 asserts)."""
+        """Invariant checks (parity: reference trainer.py:128-131 asserts).
+
+        Directed graphs swap the row-sum + symmetry invariants for the
+        column-sum one: column-stochasticity is exactly mass conservation,
+        the property push-sum's debiasing relies on (Σ_i (Ax)_i = Σ_j x_j).
+        """
         W = self.mixing_matrix
+        if np.any(W < -1e-12):
+            raise AssertionError(f"Mixing matrix must be nonnegative ({self.name})")
+        if self.directed:
+            if not np.allclose(W.sum(axis=0), 1.0):
+                raise AssertionError(
+                    f"Directed mixing matrix columns must sum to 1 ({self.name})"
+                )
+            return
         if not np.allclose(W.sum(axis=1), 1.0):
             raise AssertionError(f"Mixing matrix rows must sum to 1 ({self.name})")
         if not np.allclose(W, W.T):
             raise AssertionError(f"Mixing matrix must be symmetric ({self.name})")
-        if np.any(W < -1e-12):
-            raise AssertionError(f"Mixing matrix must be nonnegative ({self.name})")
 
 
 def _ring_adjacency(n: int) -> np.ndarray:
@@ -124,6 +164,50 @@ def _erdos_renyi_adjacency(n: int, p: float, seed: int) -> np.ndarray:
     raise RuntimeError(f"Could not sample a connected G({n}, {p}) in 1000 tries")
 
 
+def _directed_ring_adjacency(n: int) -> np.ndarray:
+    """Each node receives from its predecessor: edge (i-1) → i."""
+    adj = np.zeros((n, n))
+    ids = np.arange(n)
+    adj[ids, (ids - 1) % n] = 1.0
+    np.fill_diagonal(adj, 0.0)  # n == 1 edge case
+    return adj
+
+
+def _directed_erdos_renyi_adjacency(n: int, p: float, seed: int) -> np.ndarray:
+    """Strongly connected directed G(n, p): each ORDERED pair (j → i) draws
+    independently, resampled until every node reaches every other (checked
+    as reachability from node 0 along both edge orientations)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(1000):
+        adj = (rng.random((n, n)) < p).astype(float)
+        np.fill_diagonal(adj, 0.0)
+        # Strong connectivity ⟺ node 0 reaches all (follow in-edges of the
+        # receive convention = walk adj as "i reachable from j") and all
+        # reach node 0 (same walk on the transpose).
+        if _is_connected_directed(adj) and _is_connected_directed(adj.T):
+            return adj
+    raise RuntimeError(
+        f"Could not sample a strongly connected directed G({n}, {p}) in 1000 tries"
+    )
+
+
+def _is_connected_directed(adj: np.ndarray) -> bool:
+    """All nodes reachable from node 0 following edges j → i (adj[i, j])."""
+    n = adj.shape[0]
+    if n == 0:
+        return False
+    reached = np.zeros(n, dtype=bool)
+    frontier = [0]
+    reached[0] = True
+    while frontier:
+        j = frontier.pop()
+        for i in np.nonzero(adj[:, j])[0]:
+            if not reached[i]:
+                reached[i] = True
+                frontier.append(int(i))
+    return bool(reached.all())
+
+
 def _is_connected(adj: np.ndarray) -> bool:
     n = adj.shape[0]
     if n == 0:
@@ -155,6 +239,22 @@ def metropolis_hastings_weights(adjacency: np.ndarray) -> np.ndarray:
     return W
 
 
+def column_stochastic_weights(adjacency: np.ndarray) -> np.ndarray:
+    """Uniform-out-weight column-stochastic mixing matrix (push-sum gossip).
+
+    Node j splits its mass equally over its out-neighbors and itself:
+    A_ij = 1/(1 + outdeg_j) for every edge j → i and for i = j. Columns sum
+    to 1 by construction (mass conservation — the invariant push-sum's
+    weight debiasing rests on, Nedić-Olshevsky 2016 §II). This is the
+    standard construction when nodes know only their OUT-degree, the honest
+    information model for asymmetric links.
+    """
+    out_degrees = adjacency.sum(axis=0)
+    A = adjacency / (1.0 + out_degrees[None, :])
+    np.fill_diagonal(A, 1.0 / (1.0 + out_degrees))
+    return A
+
+
 def build_topology(
     name: str,
     n: int,
@@ -162,7 +262,29 @@ def build_topology(
     erdos_renyi_p: float = 0.4,
     seed: int = 0,
 ) -> Topology:
-    """Build a named topology over ``n`` workers, with MH mixing weights."""
+    """Build a named topology over ``n`` workers.
+
+    Undirected names get MH mixing weights; directed names
+    (``directed_ring``, ``directed_erdos_renyi``) get column-stochastic
+    uniform-out weights (the push-sum setting).
+    """
+    if name in ("directed_ring", "directed_erdos_renyi"):
+        adj = (
+            _directed_ring_adjacency(n)
+            if name == "directed_ring"
+            else _directed_erdos_renyi_adjacency(n, erdos_renyi_p, seed)
+        )
+        topo = Topology(
+            name=name,
+            n=n,
+            adjacency=adj,
+            degrees=adj.sum(axis=0),  # out-degrees (column sums)
+            mixing_matrix=column_stochastic_weights(adj),
+            directed=True,
+        )
+        topo.validate()
+        return topo
+
     grid_shape: Optional[tuple[int, int]] = None
     if name == "ring":
         adj = _ring_adjacency(n)
@@ -206,6 +328,18 @@ def ring_spectral_gap_closed_form(n: int) -> float:
         return 1.0
     lambdas = (1.0 + 2.0 * np.cos(2.0 * np.pi * np.arange(1, n) / n)) / 3.0
     return float(1.0 - np.max(np.abs(lambdas)))
+
+
+def directed_ring_spectral_gap_closed_form(n: int) -> float:
+    """Closed-form spectral gap of the uniform-out directed ring.
+
+    Out-degree 1 everywhere ⇒ A = (I + P)/2 with P the cyclic shift.
+    Eigenvalues are (1 + e^{2πik/n})/2 with modulus cos(πk/n), so
+    ρ = cos(π/n) and the gap is 1 − cos(π/n) ≈ π²/(2n²).
+    """
+    if n < 2:
+        return 1.0
+    return float(1.0 - np.cos(np.pi / n))
 
 
 def torus_spectral_gap_closed_form(side: int) -> float:

@@ -171,8 +171,11 @@ extern "C" {
 // algorithm: 0 = centralized (parameter-server SGD), 1 = D-SGD,
 //            2 = gradient tracking (DIGing), 3 = EXTRA, 4 = decentralized
 //            linearized ADMM (DLM, Ling et al. '15), 5 = CHOCO-SGD
-//            (Koloskova et al. '19 Alg. 2, deterministic compressors) —
-//            2..5 are the recursions the numpy oracle also implements
+//            (Koloskova et al. '19 Alg. 2, deterministic compressors),
+//            6 = push-sum SGP (Nedić-Olshevsky '16 / Assran et al. '19
+//            Alg. 1; W is then COLUMN-stochastic — the caller passes the
+//            directed topology's uniform-out-weight matrix) —
+//            2..6 are the recursions the numpy oracle also implements
 //            (backends/numpy_backend.py), for cross-tier verification.
 //            ADMM derives the 0/1 adjacency and degrees from W's
 //            off-diagonal support (MH weights are strictly positive on
@@ -209,14 +212,14 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
                    double *out_models, double *out_gap, double *out_cons,
                    double *out_times) {
   constexpr int kCentralized = 0, kDsgd = 1, kGT = 2, kExtra = 3, kAdmm = 4,
-                kChoco = 5;
+                kChoco = 5, kPushSum = 6;
   if (n_workers <= 0 || d <= 0 || T < 0 || eval_every <= 0 ||
       T % eval_every != 0 || batch_size < 0) {
     return 1;
   }
   if (problem < kLogistic || problem > kHuber) return 2;
   if (problem == kHuber && huber_delta <= 0.0) return 2;
-  if (algorithm < kCentralized || algorithm > kChoco) return 3;
+  if (algorithm < kCentralized || algorithm > kPushSum) return 3;
   if (algorithm == kAdmm && (admm_c <= 0.0 || admm_rho <= 0.0)) return 4;
   if (algorithm == kChoco &&
       (choco_gamma <= 0.0 || compression < 0 || compression > 1 ||
@@ -263,6 +266,15 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
     xhat.assign(nd, 0.0);
     x_half.assign(nd, 0.0);
     Wxhat.assign(nd, 0.0);
+  }
+  // Push-sum state: `models` holds the de-biased estimates z (so the shared
+  // metric/output blocks see the meaningful quantity, matching the other
+  // tiers); num/wmass carry the recursion, wmass_0 = 1.
+  std::vector<double> num, wmass, wmass_next;
+  if (algorithm == kPushSum) {
+    num.assign(nd, 0.0);
+    wmass.assign(n_workers, 1.0);
+    wmass_next.assign(n_workers, 0.0);
   }
 
   // grads <- per-worker stochastic gradient at `at` (row i per worker, or
@@ -392,6 +404,32 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
         const double *xh = xhat.data() + i * d;
         for (int64_t k = 0; k < d; ++k)
           xi[k] = hi[k] + choco_gamma * (wi[k] - xh[k]);
+      }
+    } else if (algorithm == kPushSum) {
+      // Push-sum SGP (Nedić-Olshevsky '16; Assran et al. '19 Alg. 1), W
+      // column-stochastic:
+      //   num <- W (num − η g(z));  wmass <- W wmass;  z = num / wmass
+      // Gradients at the de-biased z (= `models`). Matches the numpy
+      // oracle's matrix form and the jax step rule leaf-for-leaf.
+      compute_grads(models.data(), /*shared=*/false, t);
+#pragma omp parallel for schedule(static)
+      for (int64_t r = 0; r < nd; ++r) num[r] -= eta * grads[r];
+      apply_W(num, mixed);
+      num.swap(mixed);
+      for (int64_t i = 0; i < n_workers; ++i) {
+        double acc = 0.0;
+        for (int64_t j = 0; j < n_workers; ++j) {
+          acc += W[i * n_workers + j] * wmass[j];
+        }
+        wmass_next[i] = acc;
+      }
+      wmass.swap(wmass_next);
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < n_workers; ++i) {
+        const double inv_w = 1.0 / wmass[i];
+        double *zi = models.data() + i * d;
+        const double *ni = num.data() + i * d;
+        for (int64_t k = 0; k < d; ++k) zi[k] = ni[k] * inv_w;
       }
     } else if (algorithm == kAdmm) {
       // DLM (Ling et al. '15), node form — same recursion as

@@ -2,6 +2,8 @@
 
 import json
 
+import numpy as np
+
 from distributed_optimization_tpu.cli import build_parser, config_from_args, main
 
 
@@ -64,7 +66,7 @@ def test_presets_cover_baseline_configs(tmp_path):
 
     assert set(PRESETS) == {
         "quadratic-fc-4", "logistic-ring-8", "admm-er-16", "gt-torus-64",
-        "digits-64",
+        "digits-64", "push-sum-der-16",
     }
     # Preset end-to-end (tiny horizon), with an explicit flag overriding it.
     json_out = tmp_path / "p.json"
@@ -97,6 +99,19 @@ def test_preset_admm_er(tmp_path):
                "--n-samples", "400", "--n-features", "8",
                "--n-informative-features", "4", "--quiet"])
     assert rc == 0
+
+
+def test_preset_push_sum_der(tmp_path):
+    json_out = tmp_path / "ps.json"
+    rc = main(["--preset", "push-sum-der-16", "--n-iterations", "30",
+               "--n-samples", "400", "--n-features", "8",
+               "--n-informative-features", "4", "--quiet",
+               "--json", str(json_out)])
+    assert rc == 0
+    blob = json.loads(json_out.read_text())
+    assert blob["config"]["algorithm"] == "push_sum"
+    assert blob["config"]["topology"] == "directed_erdos_renyi"
+    assert np.all(np.isfinite(blob["runs"][0]["history"]["objective"]))
 
 
 def test_main_choco_compressed(tmp_path):

@@ -95,12 +95,13 @@ def test_deterministic_given_seed(data):
 
 
 def test_rejects_unsupported(data):
-    """All six algorithms now run on the cpp tier; the remaining carve-outs
+    """All seven algorithms now run on the cpp tier; the remaining carve-outs
     are fault injection (jax-only) and randomized CHOCO compressors
     (tested separately)."""
     ds, f_opt = data
     assert set(cpp_backend._SUPPORTED) == {
-        "centralized", "dsgd", "gradient_tracking", "extra", "admm", "choco"
+        "centralized", "dsgd", "gradient_tracking", "extra", "admm", "choco",
+        "push_sum",
     }
     with pytest.raises(ValueError, match="jax-only"):
         cpp_backend.run(CFG.replace(edge_drop_prob=0.2), ds, f_opt)

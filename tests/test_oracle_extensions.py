@@ -26,6 +26,8 @@ _EXT_ALGORITHMS = {
     "choco_topk": dict(algorithm="choco", compression="top_k",
                        compression_k=3, choco_gamma=0.25, dtype="float64"),
     "choco_identity": dict(algorithm="choco", choco_gamma=1.0),
+    "push_sum_directed": dict(algorithm="push_sum",
+                              topology="directed_erdos_renyi"),
 }
 
 

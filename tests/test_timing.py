@@ -58,19 +58,27 @@ def test_numpy_backend_reports_measured_time(data):
     assert np.all(np.diff(res.history.time) > 0)
 
 
-def test_resumed_run_carries_cumulative_time(data, tmp_path):
+@pytest.mark.parametrize("measure", [False, True])
+def test_resumed_run_carries_cumulative_time(data, tmp_path, measure):
+    """Cumulative time across installments, on BOTH checkpoint execution
+    paths: the default segmented fused scan (round 4; per-eval timestamps
+    interpolated within a segment, time_measured=False) and the opt-in
+    measured chunk loop (real per-eval samples, time_measured=True)."""
     ds, f_opt = data
+    kw = dict(measure_timestamps=True) if measure else {}
     ckdir = str(tmp_path / "ck")
     half = CFG.replace(n_iterations=30)
     first = jax_backend.run(
         half, ds, f_opt,
         checkpoint=CheckpointOptions(ckdir, every_evals=5, resume=False),
+        **kw,
     )
     resumed = jax_backend.run(
-        CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir, every_evals=5)
+        CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir, every_evals=5),
+        **kw,
     )
     t = resumed.history.time
-    assert resumed.history.time_measured
+    assert resumed.history.time_measured is measure
     assert t.shape == (10,)
     assert np.all(np.diff(t) > 0)
     # The resumed installment's clock continues from the restored offset.
