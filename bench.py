@@ -49,6 +49,7 @@ Prints exactly ONE JSON line on stdout:
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 import statistics
 import sys
@@ -218,7 +219,19 @@ def main() -> None:
         "ratio": round(jax_median / numpy_median, 2),
     }
     print(f"[bench] session record: {json.dumps(session_line)}", file=sys.stderr)
-    if not (lo <= jax_median <= hi):
+    # Escape hatch (round-5 advisor fix): the range/floor gates encode the
+    # CANONICAL chip's recorded sessions; on different hardware (another TPU
+    # generation, a CI host, heavy co-tenancy being diagnosed) an out-of-range
+    # capture means "different machine", not "docs went stale". Setting
+    # BENCH_NO_RANGE_CHECK=1 skips ONLY these two gates — convergence gates
+    # above still apply and the session record is still printed.
+    if os.environ.get("BENCH_NO_RANGE_CHECK"):
+        print(
+            "[bench] BENCH_NO_RANGE_CHECK set: skipping published-range and "
+            "floor-ratio gates (non-canonical hardware mode)",
+            file=sys.stderr,
+        )
+    elif not (lo <= jax_median <= hi):
         raise SystemExit(
             f"measured median {jax_median:.0f} iters/sec is OUTSIDE the "
             f"published range [{lo}, {hi}] from {_SESSIONS_ARTIFACT.name} — "
@@ -227,7 +240,7 @@ def main() -> None:
             "to contain every recorded session, and update the docs that "
             "cite it (docs/PERF.md, README.md, docs/ARCHITECTURE.md)."
         )
-    if jax_median / numpy_median < floor_ratio:
+    elif jax_median / numpy_median < floor_ratio:
         raise SystemExit(
             f"measured ratio {jax_median / numpy_median:.0f}x vs the "
             f"same-session numpy baseline is below the published floor "
@@ -235,11 +248,12 @@ def main() -> None:
             "ratio claims no longer contain reality; re-derive them in a "
             "commit"
         )
-    print(
-        f"[bench] self-check OK: median inside published range [{lo}, {hi}], "
-        f"ratio above {floor_ratio:.0f}x floor",
-        file=sys.stderr,
-    )
+    else:
+        print(
+            f"[bench] self-check OK: median inside published range "
+            f"[{lo}, {hi}], ratio above {floor_ratio:.0f}x floor",
+            file=sys.stderr,
+        )
 
     print(
         json.dumps(
@@ -254,6 +268,15 @@ def main() -> None:
 
 
 def _metric_name(cfg) -> str:
+    # The Nk shorthand silently mislabels horizons that are not multiples of
+    # 1000 (T=1500 would print as "T1k"); assert rather than round so a
+    # protocol change to an off-k horizon forces an explicit rename here.
+    if cfg.n_iterations % 1000 != 0:
+        raise ValueError(
+            f"metric name uses the T{{N}}k shorthand; horizon "
+            f"{cfg.n_iterations} is not a multiple of 1000 — "
+            "update _metric_name (and headline_sessions.json) explicitly"
+        )
     return (
         f"dsgd_ring_logistic_N{cfg.n_workers}_T{cfg.n_iterations // 1000}k"
         "_iters_per_sec_median5"
