@@ -30,12 +30,20 @@ Comms: one gossip round transmits the numerator (d floats) plus the scalar
 mass (1 float) per directed edge, i.e. ``comm_payload = d + 1`` — the +1 is
 push-sum's entire bandwidth overhead over plain gossip.
 
-``supports_edge_faults=False``: the failure-injection machinery
-(``parallel/faults.py``) realizes time-varying DOUBLY stochastic matrices
-from undirected edge drops; a faithful directed-fault model must instead
-re-normalize the SURVIVING out-weights column-stochastically (push-sum
-itself tolerates time-varying directed graphs — Nedić-Olshevsky analyze
-exactly that — but that machinery does not exist here yet).
+``supports_edge_faults=True`` (round 5): the failure-injection machinery
+(``parallel/faults.py``) realizes the faithful model for BOTH link
+orientations. On directed topologies each directed edge drops
+independently and every node re-splits its mass column-stochastically over
+its SURVIVING out-links (``column_stochastic_weights``) — exactly the
+time-varying directed setting of Nedić-Olshevsky 2016, whose analysis is
+push-sum's convergence guarantee here; mass conservation Σ_i w_i = N holds
+for every realization because every realized matrix is column-stochastic
+(pinned through the real backend fault paths by
+tests/test_push_sum.py::test_push_sum_mass_conserved_under_directed_faults).
+On undirected topologies the realized MH matrices are doubly stochastic,
+so w stays exactly 1 and faulty push-sum degenerates to faulty D-SGD.
+Stragglers compose: an inactive node's column collapses to identity (it
+keeps its mass) and the backend freezes all three state leaves.
 """
 
 from __future__ import annotations
@@ -71,7 +79,7 @@ PUSH_SUM = register_algorithm(
         init=_init,
         step=_step,
         gossip_rounds=1,
-        supports_edge_faults=False,
+        supports_edge_faults=True,
         # d model floats + the scalar push-sum mass per edge per round.
         comm_payload=lambda config, d: float(d + 1),
     )

@@ -25,11 +25,19 @@ A third *scheduling* mode shares the machinery:
 
 Synchronous gossip runs over the surviving graph with Metropolis–Hastings
 weights recomputed on realized degrees; an isolated or inactive node's row
-collapses to identity. Either way this is the time-varying-graph setting of
-Koloskova et al. '20 (reference report ref [13]): W_t stays symmetric and
-doubly stochastic for every realization, so the network average is preserved
-and D-SGD and DIGing-style gradient tracking remain convergent under their
-time-varying-gossip analyses. For gradient tracking this is not just the
+collapses to identity. DIRECTED topologies (round 5) instead drop each
+one-way link independently and renormalize each node's surviving
+OUT-weights column-stochastically (``column_stochastic_weights``) — the
+Nedić-Olshevsky time-varying directed setting push-sum is analyzed under;
+every realization conserves total mass (columns sum to 1), which is the
+invariant push-sum's debiasing needs, in place of the undirected case's
+doubly stochastic average preservation. For UNDIRECTED topologies (synchronous MH recomputation and every matching
+schedule) this is the time-varying-graph setting of Koloskova et al. '20
+(reference report ref [13]): W_t stays symmetric and doubly stochastic for
+every realization, so the network average is preserved and D-SGD and
+DIGing-style gradient tracking remain convergent under their
+time-varying-gossip analyses — the directed path above intentionally trades
+that invariant for column-stochastic mass conservation. For gradient tracking this is not just the
 citation: the tracking invariant mean(y_t) = mean(g_t) survives every fault
 mode because (a) each realized W_t is doubly stochastic and (b) the
 backend's straggler freeze covers ALL state leaves with the frozen node's
@@ -90,6 +98,39 @@ def sample_surviving_adjacency(key, adjacency: jax.Array, drop_prob: float):
     u = jnp.triu(u, 1)
     u = u + u.T  # symmetric: both endpoints see the same draw
     return jnp.where(u >= drop_prob, adjacency, jnp.zeros_like(adjacency))
+
+
+def sample_surviving_directed_adjacency(
+    key, adjacency: jax.Array, drop_prob: float
+):
+    """Independent iid drop per DIRECTED edge (no symmetrization).
+
+    Unlike the undirected sampler, the j→i and i→j links (when both exist)
+    fail independently — one-way links are exactly what the directed fault
+    setting models (Nedić-Olshevsky 2016 time-varying directed graphs)."""
+    u = jax.random.uniform(key, adjacency.shape)
+    return jnp.where(u >= drop_prob, adjacency, jnp.zeros_like(adjacency))
+
+
+def column_stochastic_weights(adjacency: jax.Array) -> jax.Array:
+    """Uniform-out-weight column-stochastic matrix for a realized directed
+    graph (jit-compatible).
+
+    Each node j re-splits its mass equally over its SURVIVING out-neighbors
+    and itself: W_ij = 1/(1 + outdeg_j) on realized edges, diagonal = the
+    column remainder (exactly 1/(1 + outdeg_j), so an isolated node keeps
+    all its mass). Convention matches ``parallel/topology.py``:
+    ``adjacency[i, j] = 1`` iff j sends to i, so out-degrees are COLUMN
+    sums and ``W @ x`` aggregates received mass. This is the same rule the
+    static directed topology builder uses, recomputed per realization — the
+    sender-side renormalization push-sum's time-varying-directed analysis
+    assumes (each node knows which of its out-links delivered). Columns sum
+    to 1 for every realization, so Σ_i (Wx)_i = Σ_j x_j: the mass
+    conservation push-sum's debiasing relies on survives every fault draw.
+    """
+    out_deg = jnp.sum(adjacency, axis=0)
+    W = adjacency / (1.0 + out_deg)[None, :]
+    return W + jnp.diag(1.0 - jnp.sum(W, axis=0))
 
 
 def metropolis_hastings_weights(adjacency: jax.Array) -> jax.Array:
@@ -190,6 +231,12 @@ def make_faulty_mixing(
         raise ValueError(
             f"straggler_prob must be in [0, 1), got {straggler_prob}"
         )
+    if topo.directed and one_peer:
+        raise ValueError(
+            "one_peer gossip is a mutual-matching (undirected) schedule; "
+            f"topology {topo.name!r} has one-way links, so a pairwise "
+            "exchange cannot be realized"
+        )
     base_A = jnp.asarray(topo.adjacency, dtype=jnp.float32)
     # Distinct streams from batch sampling: fold tags into the seed key.
     fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
@@ -206,7 +253,10 @@ def make_faulty_mixing(
         if drop_prob == 0.0 and straggler_prob == 0.0:
             return base_A  # no fault sampling on the fault-free fast path
         key = jax.random.fold_in(fault_key, t)
-        A_t = sample_surviving_adjacency(key, base_A, drop_prob)
+        if topo.directed:
+            A_t = sample_surviving_directed_adjacency(key, base_A, drop_prob)
+        else:
+            A_t = sample_surviving_adjacency(key, base_A, drop_prob)
         if straggler_prob > 0.0:
             m = active(t)
             A_t = A_t * m[:, None] * m[None, :]  # straggler exchanges nothing
@@ -224,10 +274,18 @@ def make_faulty_mixing(
         # Accumulate in at-least-float32: bf16 inputs get the f32 upcast the
         # accounting needs, while float64 fidelity runs keep full precision
         # (the 0/1 adjacency is exact in any dtype, so casting it up first
-        # makes the MH weights exact in the accumulation dtype).
+        # makes the MH weights exact in the accumulation dtype). Directed
+        # graphs renormalize the surviving OUT-weights column-stochastically
+        # (the push-sum fault model); undirected graphs recompute MH weights
+        # on realized degrees (doubly stochastic for every draw).
+        realized_weights = (
+            column_stochastic_weights if topo.directed
+            else metropolis_hastings_weights
+        )
+
         def mix(t, x):
             acc = jnp.promote_types(jnp.float32, x.dtype)
-            W = metropolis_hastings_weights(realized_adjacency(t).astype(acc))
+            W = realized_weights(realized_adjacency(t).astype(acc))
             return jnp.tensordot(W, x.astype(acc), axes=1).astype(x.dtype)
 
         def neighbor_sum(t, x):

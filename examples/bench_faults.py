@@ -68,6 +68,17 @@ def main() -> None:
         "gt_edge_drop_20pct": gt.replace(edge_drop_prob=0.2),
         "gt_stragglers_10pct": gt.replace(straggler_prob=0.1),
     })
+    # Push-sum over the DIRECTED ring under the directed fault model
+    # (round 5): independent one-way link drops with column-stochastic
+    # renormalization of surviving out-weights (parallel/faults.py). The
+    # d+1 payload (model + mass scalar) flows into both the analytic
+    # denominator and the realized accounting.
+    ps = base.replace(algorithm="push_sum", topology="directed_ring")
+    variants.update({
+        "ps_fault_free": ps,
+        "ps_edge_drop_20pct": ps.replace(edge_drop_prob=0.2),
+        "ps_stragglers_10pct": ps.replace(straggler_prob=0.1),
+    })
 
     runs: dict[str, list] = {name: [] for name in variants}
     results: dict[str, dict] = {}
@@ -97,15 +108,18 @@ def main() -> None:
     from distributed_optimization_tpu.algorithms import get_algorithm
     from distributed_optimization_tpu.parallel import build_topology
 
-    topo = build_topology(base.topology, base.n_workers)
-    analytic = {
-        name: float(
-            topo.floats_per_iteration * ds.n_features * cfg.n_iterations
-            * get_algorithm(cfg.algorithm).gossip_rounds
+    def _analytic(cfg):
+        topo = build_topology(cfg.topology, cfg.n_workers)
+        algo = get_algorithm(cfg.algorithm)
+        payload = (
+            algo.comm_payload(cfg, ds.n_features)
+            if algo.comm_payload is not None
+            else ds.n_features * algo.gossip_rounds
         )
-        for name, cfg in variants.items()
-    }
-    for name in ("fault_free", "gt_fault_free"):
+        return float(topo.floats_per_iteration * payload * cfg.n_iterations)
+
+    analytic = {name: _analytic(cfg) for name, cfg in variants.items()}
+    for name in ("fault_free", "gt_fault_free", "ps_fault_free"):
         assert results[name]["floats_transmitted"] == analytic[name], (
             f"{name}: fault-free floats diverge from the analytic closed form"
         )
@@ -124,14 +138,19 @@ def main() -> None:
 
     payload = {
         "device": str(jax.devices()[0]),
-        "config": "dsgd ring logistic N=64 T=20k, interleaved medians of "
+        "config": "logistic N=64 T=20k (dsgd/gt on the undirected ring, "
+                  "push_sum on the directed ring), interleaved medians of "
                   f"{args.cycles}",
         "note": "floats_vs_fault_free: realized (fault-accounted) floats "
-                "over the ANALYTIC 2|E|dT (fault-free run asserted equal) — edge drops at p=0.2 "
+                "over the ANALYTIC fault-free count (fault-free runs "
+                "asserted equal; 2|E|dT undirected, |E_dir|(d+1)T for "
+                "push_sum's model+mass payload) — edge drops at p=0.2 "
                 "should realize ~0.8, one-peer at most 1/deg_sum per node "
                 "pair, round-robin exactly 1/2 on an even ring. Convergence "
                 "under drops/stragglers degrades gracefully (time-varying "
-                "doubly stochastic W_t, Koloskova et al. '20 setting).",
+                "doubly stochastic W_t, Koloskova et al. '20, for the "
+                "undirected rows; time-varying column-stochastic chains, "
+                "Nedić-Olshevsky '16, for the ps_* rows).",
         "runs": results,
     }
     path = Path(args.out)

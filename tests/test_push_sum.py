@@ -190,15 +190,109 @@ def test_directed_topologies_reject_plain_gossip(algorithm):
         ExperimentConfig(algorithm=algorithm, topology="directed_ring")
 
 
-def test_push_sum_rejects_fault_injection():
+def test_one_peer_rejected_on_directed_topologies():
+    """Matching-based schedules are undirected constructions; directed
+    graphs must reject them at config time."""
+    for schedule in ("one_peer", "round_robin"):
+        with pytest.raises(ValueError, match="one-way links"):
+            ExperimentConfig(
+                algorithm="push_sum", topology="directed_ring",
+                gossip_schedule=schedule,
+            )
+
+
+# ------------------------------------------------- directed fault model
+
+
+def test_directed_realized_weights_column_stochastic_and_time_varying():
+    """Every realized directed-fault matrix is column-stochastic (mass
+    conservation — the invariant push-sum's debiasing needs), supported on
+    the surviving edges + diagonal, and genuinely time-varying."""
+    from distributed_optimization_tpu.parallel.faults import (
+        make_faulty_mixing,
+    )
+
+    n = 12
+    topo = build_topology("directed_erdos_renyi", n, erdos_renyi_p=0.35,
+                          seed=7)
+    faulty = make_faulty_mixing(topo, drop_prob=0.3, seed=11)
+    eye = jnp.eye(n, dtype=jnp.float32)
+    mats = [np.asarray(faulty.mix(jnp.asarray(t), eye)) for t in range(4)]
+    base_support = topo.adjacency + np.eye(n)
+    for W in mats:
+        np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-6)
+        assert np.all(W >= 0)
+        assert np.all(W[base_support == 0] == 0)  # only real edges survive
+    # Time-varying: realizations differ across iterations ...
+    assert any(not np.allclose(mats[0], W) for W in mats[1:])
+    # ... and reproducible: same (seed, t) gives the same realization.
+    again = np.asarray(faulty.mix(jnp.asarray(0), eye))
+    np.testing.assert_array_equal(mats[0], again)
+
+
+def test_directed_static_weights_match_topology_builder():
+    """drop-free renormalization reproduces the static column-stochastic
+    matrix exactly — the fault machinery is the same rule, re-realized."""
+    from distributed_optimization_tpu.parallel.faults import (
+        column_stochastic_weights,
+    )
+
+    topo = build_topology("directed_erdos_renyi", 10, erdos_renyi_p=0.4,
+                          seed=3)
+    with jax.enable_x64():
+        W = np.asarray(
+            column_stochastic_weights(
+                jnp.asarray(topo.adjacency, dtype=jnp.float64)
+            )
+        )
+    np.testing.assert_allclose(W, topo.mixing_matrix, atol=1e-12)
+
+
+@pytest.mark.parametrize(
+    "faults",
+    [dict(edge_drop_prob=0.3), dict(straggler_prob=0.2),
+     dict(edge_drop_prob=0.2, straggler_prob=0.1)],
+    ids=["edge_drop", "stragglers", "both"],
+)
+def test_push_sum_mass_conserved_under_directed_faults(faults):
+    """Through the REAL backend fault paths on a directed graph: total
+    push-sum mass Σw = N survives every fault mode to fp roundoff, w stays
+    positive, x stays the de-biased num/w, and the realized comms
+    accounting honestly undercounts the fault-free analytic."""
     cfg = small_backend_config(
-        algorithm="push_sum", topology="directed_ring", edge_drop_prob=0.2,
-        n_iterations=10,
+        algorithm="push_sum", topology="directed_erdos_renyi",
+        erdos_renyi_p=0.35, dtype="float64", n_iterations=300,
+        eval_every=50, **faults,
     )
     ds = generate_synthetic_dataset(cfg)
     _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
-    with pytest.raises(ValueError, match="column-stochastically"):
-        jax_backend.run(cfg, ds, f_opt)
+    r = jax_backend.run(cfg, ds, f_opt, return_state=True)
+    w = r.final_state["w"]
+    assert np.all(w > 0)
+    assert w.sum() == pytest.approx(cfg.n_workers, abs=1e-9)
+    np.testing.assert_allclose(
+        r.final_state["x"], r.final_state["num"] / w, rtol=1e-12
+    )
+    gaps = r.history.objective
+    assert np.all(np.isfinite(gaps))
+    assert gaps[-1] < gaps[0]  # still optimizing through the faults
+    topo = build_topology(cfg.topology, cfg.n_workers,
+                          erdos_renyi_p=cfg.erdos_renyi_p, seed=cfg.seed)
+    analytic = topo.adjacency.sum() * (ds.n_features + 1) * cfg.n_iterations
+    assert r.history.total_floats_transmitted < analytic
+
+
+def test_push_sum_mass_stays_one_under_undirected_faults(quad_setup):
+    """On an undirected topology the realized MH matrices stay doubly
+    stochastic under faults, so faulty push-sum's mass never moves — the
+    degenerate case survives failure injection too."""
+    cfg, ds, f_opt = quad_setup
+    r = jax_backend.run(
+        cfg.replace(algorithm="push_sum", dtype="float64", n_iterations=80,
+                    edge_drop_prob=0.25),
+        ds, f_opt, return_state=True,
+    )
+    np.testing.assert_allclose(r.final_state["w"], 1.0, atol=1e-12)
 
 
 # ------------------------------------------------------- state invariants
