@@ -121,6 +121,13 @@ def run(
             "algorithms plus matrix/node-form GT/EXTRA/ADMM/CHOCO); "
             f"{config.algorithm!r} is a jax-backend capability"
         )
+    if config.problem_type == "softmax":
+        raise ValueError(
+            "the native core's C ABI models per-worker parameters as "
+            "d-vectors with scalar-output GLM kernels (gossip_core.cpp); "
+            "softmax — the compute-bound matrix-parameter tier — is a "
+            "jax/numpy-backend capability"
+        )
     if (
         config.edge_drop_prob > 0.0
         or config.straggler_prob > 0.0

@@ -97,7 +97,12 @@ def run(
     algo = get_algorithm(config.algorithm)
     T = config.n_iterations
     n = config.n_workers
+    # Trained parameter dimension: the softmax family's flat [d·K] matrix,
+    # n_features for the scalar GLMs (mirrors jax_backend's
+    # problem.param_dim without importing the jax problem registry).
     d = dataset.n_features
+    if config.problem_type == "softmax":
+        d = dataset.n_features * config.n_classes
     reg = config.reg_param
     objective = losses_np.OBJECTIVES[config.problem_type]
     gradient = losses_np.GRADIENTS[config.problem_type]

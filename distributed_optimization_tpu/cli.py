@@ -113,6 +113,9 @@ def build_parser() -> argparse.ArgumentParser:
                       default=_DEFAULTS.n_informative_features)
     prob.add_argument("--classification-sep", type=float,
                       default=_DEFAULTS.classification_sep)
+    prob.add_argument("--n-classes", type=int, default=_DEFAULTS.n_classes,
+                      help="class count K for --problem-type softmax (the "
+                           "compute-bound [d,K]-matrix-parameter family)")
     prob.add_argument("--dataset", choices=("synthetic", "digits"),
                       default="synthetic",
                       help="'digits' = real image features (the MNIST-features "
@@ -171,7 +174,7 @@ def build_parser() -> argparse.ArgumentParser:
                             "parity)")
     execg.add_argument("--mixing-impl",
                        choices=("auto", "dense", "stencil", "shard_map",
-                                "pallas"),
+                                "pallas", "sparse"),
                        default=_DEFAULTS.mixing_impl)
     execg.add_argument("--sampling-impl",
                        choices=("auto", "gather", "dense"),
@@ -257,6 +260,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         admm_c=args.admm_c,
         admm_rho=args.admm_rho,
         huber_delta=args.huber_delta,
+        n_classes=args.n_classes,
         compression=args.compression,
         compression_k=args.compression_k,
         choco_gamma=args.choco_gamma,

@@ -32,7 +32,7 @@ TOPOLOGIES = ("ring", "grid", "fully_connected", "erdos_renyi", "chain", "star",
 # tracked mass — may run on them.
 DIRECTED_TOPOLOGIES = ("directed_ring", "directed_erdos_renyi")
 
-PROBLEM_TYPES = ("logistic", "quadratic", "huber")
+PROBLEM_TYPES = ("logistic", "quadratic", "huber", "softmax")
 
 BACKENDS = ("jax", "numpy", "cpp")
 
@@ -94,6 +94,11 @@ class ExperimentConfig:
     compression: str = "none"
     compression_k: int = 0
     choco_gamma: float = 0.3
+    # Class count for the multinomial softmax family (problem_type='softmax'
+    # only — the compute-bound objective tier, models/softmax.py). The
+    # parameter is a [n_features, n_classes] matrix flattened to d·K for the
+    # mixing/algorithm layers; K also scales the per-edge gossip payload.
+    n_classes: int = 10
     # Huber transition point δ (problem_type='huber' only); see
     # DEFAULT_HUBER_DELTA for the default's rationale. Threaded through all
     # three tiers: jax closures (models/huber.py), numpy twins
@@ -117,10 +122,12 @@ class ExperimentConfig:
     # cycles deterministic matchings that cover the edge set every P
     # iterations (ring/chain/even-sided grid).
     gossip_schedule: str = "synchronous"
-    # 'auto' | 'dense' | 'stencil' | 'shard_map' | 'pallas'. 'auto' picks the
-    # measured winner per platform (docs/perf/mixing_bench.json): the fused
-    # pallas kernel for single-chip-TPU dsgd/ring/f32, else stencil where the
-    # graph embeds as mesh shifts, else dense.
+    # 'auto' | 'dense' | 'stencil' | 'shard_map' | 'pallas' | 'sparse'.
+    # 'auto' picks the measured winner: stencil where the graph embeds as
+    # mesh shifts, else dense (round 5: the 7-dim pallas sweep found no
+    # reproducible win — docs/perf/pallas_regimes.json — and the CSR sparse
+    # form measured slower than dense at every cell —
+    # docs/perf/sparse_mixing.json; both remain explicit opt-ins).
     mixing_impl: str = "auto"
     # 'auto' | 'gather' | 'dense'. Mini-batch realization on the jax backend:
     # 'gather' materializes [N, b, d] batches (top_k + row gathers), 'dense'
@@ -149,7 +156,7 @@ class ExperimentConfig:
         if self.backend not in BACKENDS:
             raise ValueError(f"Unknown backend: {self.backend}")
         if self.mixing_impl not in ("auto", "dense", "stencil", "shard_map",
-                                    "pallas"):
+                                    "pallas", "sparse"):
             raise ValueError(f"Unknown mixing impl: {self.mixing_impl}")
         if self.sampling_impl not in ("auto", "gather", "dense"):
             raise ValueError(f"Unknown sampling impl: {self.sampling_impl}")
@@ -171,6 +178,10 @@ class ExperimentConfig:
                 )
         if self.huber_delta <= 0.0:
             raise ValueError(f"huber_delta must be positive, got {self.huber_delta}")
+        if self.n_classes < 2:
+            raise ValueError(
+                f"n_classes must be >= 2, got {self.n_classes}"
+            )
         if self.algorithm == "choco" and not 0.0 < self.choco_gamma <= 1.0:
             raise ValueError(
                 f"choco_gamma must be in (0, 1], got {self.choco_gamma}"
@@ -221,6 +232,18 @@ class ExperimentConfig:
                 raise ValueError(
                     f"grid topology requires a perfect-square worker count, got {self.n_workers}"
                 )
+        if (
+            self.topology in DIRECTED_TOPOLOGIES
+            and self.gossip_schedule != "synchronous"
+        ):
+            raise ValueError(
+                f"gossip_schedule={self.gossip_schedule!r} realizes mutual "
+                "pairwise matchings, an undirected construction; directed "
+                f"topology {self.topology!r} has one-way links — use "
+                "'synchronous' (edge_drop_prob/straggler_prob compose with "
+                "it via column-stochastic renormalization of surviving "
+                "out-links)"
+            )
         if (
             self.topology in DIRECTED_TOPOLOGIES
             and self.algorithm != "push_sum"

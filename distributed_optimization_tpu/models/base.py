@@ -29,6 +29,11 @@ class Problem:
     - ``objective_weighted(w, X, y, weights, reg)`` / ``gradient_weighted`` —
       per-sample-weight forms used on the TPU path (static shapes; weights
       encode masking / effective batch size).
+    - ``param_dim(n_features)`` — the flattened parameter dimension for a
+      d-feature dataset. Identity for the scalar-output GLMs; d·K for the
+      softmax family, whose [d, K] weight matrix travels through the
+      mixing/algorithm layers as a flat vector (gossip is elementwise over
+      the parameter axis, so flattening is exact).
     """
 
     name: str
@@ -36,6 +41,7 @@ class Problem:
     gradient: Callable[..., jax.Array]
     objective_weighted: Callable[..., jax.Array]
     gradient_weighted: Callable[..., jax.Array]
+    param_dim: Callable[[int], int] = lambda d: d
 
 
 _REGISTRY: dict[str, Problem] = {}
@@ -46,19 +52,33 @@ def register_problem(problem: Problem) -> Problem:
     return problem
 
 
-def get_problem(name: str, *, huber_delta: float | None = None) -> Problem:
+def get_problem(
+    name: str,
+    *,
+    huber_delta: float | None = None,
+    n_classes: int | None = None,
+) -> Problem:
     """Look up a problem family by name ('logistic', 'quadratic', ...).
 
     ``huber_delta`` binds the Huber transition point (ignored for other
     families); ``None`` means the registered default
-    (config.DEFAULT_HUBER_DELTA). Per-δ Problems are cached so jit static
-    arguments stay identical across calls.
+    (config.DEFAULT_HUBER_DELTA). ``n_classes`` binds the softmax family's
+    class count (ignored elsewhere; ``None`` means the registered default).
+    Per-parameter Problems are cached so jit static arguments stay
+    identical across calls.
     """
     # Import here so registration happens on first use without import cycles.
-    from distributed_optimization_tpu.models import huber, logistic, quadratic  # noqa: F401
+    from distributed_optimization_tpu.models import (  # noqa: F401
+        huber,
+        logistic,
+        quadratic,
+        softmax,
+    )
 
     if name not in _REGISTRY:
         raise ValueError(f"Unknown problem type: {name!r}; known: {sorted(_REGISTRY)}")
     if name == "huber" and huber_delta is not None:
         return huber.make_huber_problem(float(huber_delta))
+    if name == "softmax" and n_classes is not None:
+        return softmax.make_softmax_problem(int(n_classes))
     return _REGISTRY[name]

@@ -1,0 +1,46 @@
+"""L2-regularized multinomial (softmax) logistic regression — the
+compute-bound objective family.
+
+Not in the reference (``obj_problems.py``'s GLMs are all scalar-output) —
+this is the framework's MXU tier: the [d, K] weight matrix makes the
+per-worker gradient a pair of real matmuls (X @ W forward, X^T @ (P − Y)
+backward, 2·b·d·K FLOPs each) instead of the scalar GLMs' matvecs, so wide
+(d, K) configurations load the systolic array instead of the memory bus
+(measured: docs/perf/compute_bound.json, docs/PERF.md §compute-bound).
+
+Parameters travel flattened ([d·K]) through mixing/algorithms — gossip is
+elementwise over the parameter axis, so flattening is exact; ``param_dim``
+tells the backends how long the flat vector is. The kernels themselves
+infer K from static shapes (``ops/losses.py`` softmax section), so the
+bound class count only sizes the parameter vector.
+"""
+
+import functools
+
+from distributed_optimization_tpu.models.base import Problem, register_problem
+from distributed_optimization_tpu.ops import losses
+
+DEFAULT_N_CLASSES = 10
+
+
+@functools.lru_cache(maxsize=None)
+def make_softmax_problem(n_classes: int) -> Problem:
+    """Softmax Problem with the class count bound to ``n_classes``.
+
+    Cached per K so a given class count always yields the SAME callable
+    objects — the backends pass these as jit static arguments, and a fresh
+    instance per call would defeat XLA's compilation cache.
+    """
+    if n_classes < 2:
+        raise ValueError(f"softmax needs n_classes >= 2, got {n_classes}")
+    return Problem(
+        name="softmax",
+        objective=losses.softmax_objective,
+        gradient=losses.softmax_gradient,
+        objective_weighted=losses.softmax_objective_weighted,
+        gradient_weighted=losses.softmax_gradient_weighted,
+        param_dim=lambda d: d * n_classes,
+    )
+
+
+SOFTMAX = register_problem(make_softmax_problem(DEFAULT_N_CLASSES))

@@ -170,6 +170,67 @@ def huber_gradient_weighted(
     return X.T @ coeff + lam * w
 
 
+# ---------------------------------------------------------------------------
+# Multinomial (softmax) logistic regression (convex):
+#   f(W) = mean_i [logsumexp(x_i^T W) − (x_i^T W)_{y_i}] + (λ/2)‖W‖_F²,
+#   W ∈ R^{d×K}, labels y_i ∈ {0, …, K−1}
+#
+# Not in the reference (its GLMs are scalar-output, reference
+# obj_problems.py:3-69) — this is the framework's COMPUTE-BOUND tier: the
+# scalar GLM gradients are matvecs (arithmetic intensity O(1), forever
+# HBM-bound on TPU), while the softmax forward X @ W [b,K] and backward
+# X^T @ (P − Y) [d,K] are real matmuls with 2·b·d·K FLOPs each that tile
+# onto the MXU. docs/PERF.md §compute-bound measures the MFU this family
+# reaches where the toy tier cannot.
+#
+# Parameters travel FLATTENED ([d·K] vectors) through the mixing/algorithm
+# layers — gossip is elementwise over the parameter axis, so flattening is
+# exact — and are reshaped here; K is inferred from the static shapes
+# (w.size / X.shape[-1]), so the kernels need no bound class count.
+# ---------------------------------------------------------------------------
+
+
+def _softmax_ce(logits: jax.Array, y: jax.Array) -> jax.Array:
+    """Per-sample cross-entropy: logsumexp(logits) − logits[y] (stable)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    true = jnp.take_along_axis(
+        logits, y.astype(jnp.int32)[:, None], axis=-1
+    )[:, 0]
+    return lse - true
+
+
+def softmax_objective(w: jax.Array, X: jax.Array, y: jax.Array, lam: float) -> jax.Array:
+    logits = X @ w.reshape(X.shape[-1], -1)
+    return jnp.mean(_softmax_ce(logits, y)) + 0.5 * lam * jnp.dot(w, w)
+
+
+def softmax_gradient(w: jax.Array, X: jax.Array, y: jax.Array, lam: float) -> jax.Array:
+    W = w.reshape(X.shape[-1], -1)
+    logits = X @ W
+    P = jax.nn.softmax(logits, axis=-1)
+    Y = jax.nn.one_hot(y.astype(jnp.int32), W.shape[1], dtype=X.dtype)
+    G = X.T @ (P - Y) / X.shape[0] + lam * W
+    return G.reshape(-1)
+
+
+def softmax_objective_weighted(
+    w: jax.Array, X: jax.Array, y: jax.Array, weights: jax.Array, lam: float
+) -> jax.Array:
+    logits = X @ w.reshape(X.shape[-1], -1)
+    return jnp.sum(weights * _softmax_ce(logits, y)) + 0.5 * lam * jnp.dot(w, w)
+
+
+def softmax_gradient_weighted(
+    w: jax.Array, X: jax.Array, y: jax.Array, weights: jax.Array, lam: float
+) -> jax.Array:
+    W = w.reshape(X.shape[-1], -1)
+    logits = X @ W
+    P = jax.nn.softmax(logits, axis=-1)
+    Y = jax.nn.one_hot(y.astype(jnp.int32), W.shape[1], dtype=X.dtype)
+    G = X.T @ (weights[:, None] * (P - Y)) + lam * W
+    return G.reshape(-1)
+
+
 def batch_weights(mask: jax.Array) -> jax.Array:
     """Turn a validity mask into mean-weights: mask / max(1, sum(mask)).
 

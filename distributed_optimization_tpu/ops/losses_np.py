@@ -77,7 +77,33 @@ def huber_gradient(w, X, y, lam, delta=DEFAULT_HUBER_DELTA):
     return X.T @ coeff / X.shape[0] + lam * w
 
 
+def softmax_objective(w, X, y, lam):
+    """Multinomial logistic (cross-entropy) objective; K inferred from the
+    flat parameter size (w.size // d — see ops/losses.py softmax section)."""
+    if X.shape[0] == 0:
+        return 0.0
+    W = w.reshape(X.shape[1], -1)
+    logits = X @ W
+    m = logits.max(axis=1, keepdims=True)
+    lse = (m[:, 0] + np.log(np.exp(logits - m).sum(axis=1)))
+    true = logits[np.arange(X.shape[0]), y.astype(np.int64)]
+    return float(np.mean(lse - true) + 0.5 * lam * np.dot(w, w))
+
+
+def softmax_gradient(w, X, y, lam):
+    if X.shape[0] == 0:
+        return np.zeros_like(w)
+    W = w.reshape(X.shape[1], -1)
+    logits = X @ W
+    m = logits.max(axis=1, keepdims=True)
+    e = np.exp(logits - m)
+    P = e / e.sum(axis=1, keepdims=True)
+    P[np.arange(X.shape[0]), y.astype(np.int64)] -= 1.0
+    G = X.T @ P / X.shape[0] + lam * W
+    return G.reshape(-1)
+
+
 OBJECTIVES = {"logistic": logistic_objective, "quadratic": quadratic_objective,
-              "huber": huber_objective}
+              "huber": huber_objective, "softmax": softmax_objective}
 GRADIENTS = {"logistic": logistic_gradient, "quadratic": quadratic_gradient,
-             "huber": huber_gradient}
+             "huber": huber_gradient, "softmax": softmax_gradient}

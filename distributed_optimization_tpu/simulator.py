@@ -83,6 +83,7 @@ class Simulator:
         self.w_opt, self.f_opt = compute_reference_optimum(
             self.dataset, base_config.reg_param,
             huber_delta=base_config.huber_delta,
+            n_classes=base_config.n_classes,
         )
         self.records: list[ExperimentRecord] = []
 

@@ -88,6 +88,31 @@ def generate_synthetic_dataset(config) -> HostDataset:
             random_state=config.seed,
         )
         y = y.astype(np.float64) * 2.0 - 1.0
+    elif config.problem_type == "softmax":
+        # Same generator as logistic with K classes; labels stay 0..K−1
+        # (float-stored class indices — the softmax kernels cast back).
+        # The separability constraint is make_classification's, so it lives
+        # here with the call, not in config: the digits path has real
+        # classes and ignores n_informative_features entirely.
+        if config.n_classes > 2**config.n_informative_features:
+            raise ValueError(
+                f"n_classes ({config.n_classes}) exceeds what "
+                f"{config.n_informative_features} informative features can "
+                "separate (sklearn make_classification requires n_classes "
+                "<= 2^n_informative)"
+            )
+        X, y = make_classification(
+            n_samples=config.n_samples,
+            n_features=config.n_features,
+            n_informative=config.n_informative_features,
+            n_redundant=config.n_features - config.n_informative_features,
+            n_classes=config.n_classes,
+            n_clusters_per_class=1,
+            flip_y=0.05,
+            class_sep=config.classification_sep,
+            random_state=config.seed,
+        )
+        y = y.astype(np.float64)
     elif config.problem_type in ("quadratic", "huber"):
         # Huber shares the regression pipeline (same targets, same noise=10
         # scale its delta is calibrated to).
@@ -134,6 +159,15 @@ def generate_digits_dataset(config) -> HostDataset:
     X, digit = X[:n], digit[:n]
     if config.problem_type == "logistic":
         y = np.where(digit >= 5, 1.0, -1.0)
+    elif config.problem_type == "softmax":
+        # The natural multiclass form of the digits task: the ten digit
+        # classes ARE the labels. The config must budget all of them.
+        if config.n_classes < 10:
+            raise ValueError(
+                "digits has 10 classes; softmax needs n_classes >= 10 "
+                f"(got {config.n_classes})"
+            )
+        y = digit.astype(np.float64)
     else:
         y = digit.astype(np.float64)
 
@@ -192,13 +226,22 @@ def partition_summary(dataset: HostDataset, max_workers: int = 32) -> str:
 
 
 def stack_shards(dataset: HostDataset, dtype=np.float32) -> DeviceDataset:
-    """Stack ragged shards into padded [N, L, d] arrays for the device path."""
+    """Stack ragged shards into padded [N, L, d] arrays for the device path.
+
+    Softmax labels are CLASS INDICES and stay int32 regardless of the run
+    dtype: under bfloat16 (8-bit significand) every odd index above 256
+    would silently round to its even neighbor — at the compute-bound
+    tier's K=512 that corrupts ~25% of the labels while throughput looks
+    normal. The kernels consume them via ``y.astype(int32)`` either way
+    (ops/losses.py softmax section), so only the storage changes.
+    """
     n = dataset.n_workers
     d = dataset.n_features
     sizes = np.array([len(idx) for idx in dataset.shard_indices], dtype=np.int32)
     L = int(sizes.max()) if n else 0
+    y_dtype = np.int32 if dataset.problem_type == "softmax" else dtype
     X = np.zeros((n, L, d), dtype=dtype)
-    y = np.zeros((n, L), dtype=dtype)
+    y = np.zeros((n, L), dtype=y_dtype)
     for i in range(n):
         Xi, yi = dataset.shard(i)
         X[i, : sizes[i]] = Xi

@@ -25,12 +25,16 @@ def compute_reference_optimum(
     max_iter: int = 50_000,
     tol: float = 1e-9,
     huber_delta: float | None = None,
+    n_classes: int | None = None,
 ) -> tuple[np.ndarray, float]:
     """Return (w_opt [d], f_opt) for the dataset's problem type.
 
     ``huber_delta`` sets the Huber transition point (huber only; ``None`` =
     the config default) — the optimum depends on δ, so the oracle must use
-    the same δ as the backends under test.
+    the same δ as the backends under test. ``n_classes`` sets the softmax
+    class count (softmax only; ``None`` infers K = max(y) + 1); the
+    returned w_opt is the flattened [d·K] parameter, matching the layout
+    the backends train.
     """
     from sklearn.linear_model import LogisticRegression, Ridge
 
@@ -92,6 +96,37 @@ def compute_reference_optimum(
         w_opt = res.x
         f_opt = losses_np.huber_objective(
             w_opt, dataset.X_full, y, reg_param, delta=delta
+        )
+    elif dataset.problem_type == "softmax":
+        # Multinomial cross-entropy + full-matrix L2: scipy L-BFGS on the
+        # float64 numpy twin (like huber — sklearn's multinomial solvers
+        # leave one class unpenalized or reparameterize, so they do not
+        # minimize THIS objective exactly; the twin is the shared metric
+        # definition all backends are judged against anyway). The L2 term
+        # makes the objective strictly convex, so the softmax family's
+        # usual shift degeneracy is resolved and the optimum is unique.
+        from scipy.optimize import minimize
+
+        K = (
+            int(n_classes)
+            if n_classes is not None
+            else int(dataset.y_full.max()) + 1
+        )
+        d = dataset.X_full.shape[1]
+        res = minimize(
+            lambda w: losses_np.softmax_objective(
+                w, dataset.X_full, y, reg_param
+            ),
+            np.zeros(d * K),
+            jac=lambda w: losses_np.softmax_gradient(
+                w, dataset.X_full, y, reg_param
+            ),
+            method="L-BFGS-B",
+            options={"maxiter": max_iter, "ftol": tol * 1e-2, "gtol": 1e-10},
+        )
+        w_opt = res.x
+        f_opt = losses_np.softmax_objective(
+            w_opt, dataset.X_full, y, reg_param
         )
     else:
         raise ValueError(f"Unknown problem type: {dataset.problem_type}")

@@ -1,0 +1,198 @@
+"""Compute-bound regime demonstration (round 5, VERDICT r4 item 1).
+
+Every number the repo measured through round 4 lives in the latency-bound
+d<=1024 scalar-GLM regime — ~1-7 MFLOP per iteration against a chip that
+does ~10^5x that per millisecond, MFU <= 0.5%, MXU idle (docs/PERF.md §3).
+This bench runs the tier TPUs are built for: the SOFTMAX family
+(models/softmax.py), whose per-worker gradient is two real matmuls
+(forward [b,d]x[d,K], backward [d,b]x[b,K]) — 4·N·b·d·K FLOPs per
+iteration through the same D-SGD ring pipeline as the headline.
+
+Reported per cell: steady-state iters/sec (fused scan, metrics off, AOT
+compile excluded), achieved TFLOP/s from the analytic FLOP count, MFU
+against the chip's bf16 peak, and the minimum HBM traffic (X re-read + 3x
+weight traffic per iteration) as achieved GB/s. Cells interleave across
+cycles (shared-chip protocol); the aggregate is the MEDIAN of cycles whose
+reading is physically possible (achieved <= 95% of peak — the tunneled
+runtime intermittently returns from the FIRST execution of a freshly
+compiled large program in ~1 ms, implying thousands of times the chip's
+peak; raw readings are recorded, impossible ones excluded). dtype/
+precision cells re-judge the round-3 "bf16 no win" verdict — a
+latency-bound statement — where FLOPs dominate.
+
+FLOP accounting is the dominant matmul pair only (4NbdK); softmax/one-hot/
+mixing/sampling are O(N·b·K + N·d·K) lower-order terms left out of the
+numerator, so MFU is slightly UNDERstated — the conservative direction.
+
+Peak numbers: TPU v5e (v5 lite) = 197 TFLOP/s bf16, 819 GB/s HBM
+(public spec). Override with BENCH_PEAK_TFLOPS / BENCH_PEAK_GBPS for other
+chips; f32 'highest' runs 6 bf16 passes per matmul (its effective ceiling
+is peak/6 — reported MFU stays relative to the bf16 peak so cells share
+one denominator).
+
+Data is generated directly (random standardized X, uniform labels) rather
+than through sklearn: throughput does not depend on learnability, and
+make_classification at d=8192 costs minutes the measurement does not need.
+Correctness/convergence of the family is pinned at small shapes in
+tests/test_softmax.py.
+
+Writes ``docs/perf/compute_bound.json``.
+
+Usage:  python examples/bench_compute_bound.py [--out PATH] [--cycles 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+PEAK_GBPS = float(os.environ.get("BENCH_PEAK_GBPS", "819"))
+
+
+def _random_dataset(n_workers: int, b: int, d_feat: int, n_classes: int):
+    """HostDataset with random standardized features + uniform labels; each
+    worker's shard is exactly its batch (full-batch local gradients)."""
+    from distributed_optimization_tpu.utils.data import HostDataset
+
+    rng = np.random.default_rng(0)
+    n = n_workers * b
+    X = rng.standard_normal((n, d_feat)).astype(np.float64)
+    X = np.hstack([X, np.ones((n, 1))])
+    y = rng.integers(0, n_classes, size=n).astype(np.float64)
+    shard_indices = [np.arange(i * b, (i + 1) * b) for i in range(n_workers)]
+    return HostDataset(X_full=X, y_full=y, shard_indices=shard_indices,
+                       problem_type="softmax")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cycles", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=2000)
+    ap.add_argument("--out", default="docs/perf/compute_bound.json")
+    args = ap.parse_args()
+
+    import jax
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.config import ExperimentConfig
+
+    dev = jax.devices()[0]
+    print(f"[compute_bound] device={dev} peak={PEAK_TFLOPS}TF/s "
+          f"{PEAK_GBPS}GB/s", file=sys.stderr)
+
+    N, K, b = 8, 512, 2048
+    T = args.iters
+    # (label, d_feat, dtype, matmul_precision). 'highest' is the framework
+    # default (parity-sensitive math: 6-pass bf16 ~ f32 accuracy); 'default'
+    # is the 1-pass bf16-data-path XLA uses when precision is not forced.
+    cells = [
+        ("d4096_f32_highest", 4096, "float32", "highest"),
+        ("d4096_f32_default", 4096, "float32", "default"),
+        ("d4096_bf16", 4096, "bfloat16", "default"),
+        ("d8192_f32_default", 8192, "float32", "default"),
+        ("d8192_bf16", 8192, "bfloat16", "default"),
+    ]
+
+    runs: dict[str, list] = {label: [] for label, *_ in cells}
+    setups = {}
+    for label, d_feat, dtype, prec in cells:
+        cfg = ExperimentConfig(
+            problem_type="softmax", n_classes=K, algorithm="dsgd",
+            topology="ring", n_workers=N, local_batch_size=b,
+            n_samples=N * b, n_features=d_feat,
+            n_informative_features=64, n_iterations=T, eval_every=T,
+            dtype=dtype, matmul_precision=prec, record_consensus=False,
+            # Pin the stencil (what auto resolves to on a ring): the cells
+            # measure the gradient matmuls, and pinning keeps the mixing
+            # term identical across cells by construction.
+            mixing_impl="stencil",
+            # At ~1 ms/iter the unroll's dispatch savings are irrelevant and
+            # unrolled bodies multiply live [N, b, d] buffers; keep the scan
+            # rolled so peak memory stays ~2 batches.
+            scan_unroll=1,
+        )
+        ds = _random_dataset(N, b, d_feat, K)
+        setups[label] = (cfg, ds, d_feat)
+
+    for c in range(args.cycles):
+        for label, (cfg, ds, d_feat) in setups.items():
+            r = jax_backend.run(cfg, ds, 0.0, collect_metrics=False,
+                                measure_compile=(c == 0))
+            ips = float(r.history.iters_per_second)
+            runs[label].append(ips)
+            print(f"[compute_bound] cycle {c + 1}/{args.cycles} {label:20s} "
+                  f"{ips:8.1f} iters/sec "
+                  f"(compile {r.history.compile_seconds:.1f}s)",
+                  file=sys.stderr)
+
+    import statistics
+
+    results = {}
+    for label, (cfg, ds, d_feat) in setups.items():
+        d = d_feat + 1  # bias column
+        flops_per_iter = 4.0 * N * b * d * K
+        # Median of physically-possible readings: nothing exceeds peak.
+        cap_ips = 0.95 * PEAK_TFLOPS * 1e12 / flops_per_iter
+        ok = [r for r in runs[label] if 0 < r <= cap_ips]
+        ips = statistics.median(ok if ok else runs[label])
+        bytes_el = 2 if cfg.dtype == "bfloat16" else 4
+        # Minimum HBM traffic: X re-read twice (fwd+bwd) + W read twice /
+        # written once per worker per iteration. Logits/softmax intermediates
+        # assumed fused (XLA does); this is a LOWER bound on real traffic.
+        bytes_per_iter = (2 * N * b * d + 3 * N * d * K) * bytes_el
+        achieved_tf = flops_per_iter * ips / 1e12
+        results[label] = {
+            "d_model": d * K,
+            "dtype": cfg.dtype,
+            "matmul_precision": cfg.matmul_precision,
+            "iters_per_sec_median_possible": round(ips, 1),
+            "iters_per_sec_cycles_raw": [round(x, 1) for x in runs[label]],
+            "readings_excluded_impossible": len(runs[label]) - len(ok),
+            "gflops_per_iter": round(flops_per_iter / 1e9, 2),
+            "achieved_tflops": round(achieved_tf, 1),
+            "mfu_vs_bf16_peak": round(achieved_tf / PEAK_TFLOPS, 3),
+            "min_hbm_gbps": round(bytes_per_iter * ips / 1e9, 1),
+            "hbm_util_lower_bound": round(
+                bytes_per_iter * ips / 1e9 / PEAK_GBPS, 3
+            ),
+        }
+        row = results[label]
+        print(f"[compute_bound] {label:20s} {row['achieved_tflops']:6.1f} "
+              f"TF/s  MFU {row['mfu_vs_bf16_peak'] * 100:5.1f}%  HBM>= "
+              f"{row['min_hbm_gbps']:5.0f} GB/s "
+              f"({row['hbm_util_lower_bound'] * 100:.0f}%)", file=sys.stderr)
+
+    payload = {
+        "device": str(dev),
+        "peak_tflops_bf16": PEAK_TFLOPS,
+        "peak_hbm_gbps": PEAK_GBPS,
+        "workload": (
+            f"softmax D-SGD ring N={N}, K={K}, b={b} (full local batch), "
+            f"T={T}, fused scan, metrics off; FLOPs/iter = 4NbdK (dominant "
+            "matmuls only, lower-order terms excluded => MFU conservative); "
+            f"median of {args.cycles} interleaved cycles passing the "
+            "physical cap (raw cycles recorded; first-execution "
+            "bogus-fast readings excluded)"
+        ),
+        "cells": results,
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    print(json.dumps({
+        "metric": "compute_bound_median_mfu_best_cell",
+        "value": max(r["mfu_vs_bf16_peak"] for r in results.values()),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -144,3 +144,65 @@ def test_default_is_fused_at_every_cadence(data):
     )
     assert prime.history.objective.shape == (9,)
     assert np.all(np.isfinite(prime.history.objective))
+
+
+def test_hoisted_form_evals_exactly_on_cadence(data, monkeypatch):
+    """Round 5 (VERDICT r4 item 6): for eval-dominated coarse-cadence runs
+    the fused path runs the HOISTED form — eval-free flat scans with the
+    eval between them — paying the eval exactly once per cadence point.
+    Forced here via the measured gate (small test datasets are never
+    eval-dominated); trajectory must match the fine-cadence inline form at
+    shared eval points to fp exactness (same step sequence, f64)."""
+    ds, f_opt = data
+    monkeypatch.setattr(jax_backend, "HOISTED_MIN_RATIO", 0.0)
+    coarse = CFG.replace(n_iterations=64, eval_every=16, scan_unroll=4,
+                         dtype="float64")
+    fine = coarse.replace(eval_every=1)
+    rc = jax_backend.run(coarse, ds, f_opt)   # micro=4 -> hoisted
+    rf = jax_backend.run(fine, ds, f_opt)     # micro=1 -> inline-on-cadence
+    assert rc.history.objective.shape == (4,)
+    np.testing.assert_allclose(
+        rc.history.objective, rf.history.objective[15::16], rtol=1e-12
+    )
+    np.testing.assert_allclose(rc.final_models, rf.final_models, rtol=1e-12)
+
+
+def test_hoisted_checkpoint_segments_resume_exactly(data, tmp_path,
+                                                    monkeypatch):
+    """Checkpointed coarse-cadence runs hoist per segment (gate forced);
+    interrupting and resuming must reproduce the uninterrupted trajectory
+    bit-for-bit (the counter-based RNG + traced-offset design)."""
+    ds, f_opt = data
+    monkeypatch.setattr(jax_backend, "HOISTED_MIN_RATIO", 0.0)
+    cfg = CFG.replace(n_iterations=80, eval_every=20, scan_unroll=4,
+                      dtype="float64")
+    full = jax_backend.run(cfg, ds, f_opt)
+    opts = CheckpointOptions(directory=str(tmp_path / "ck"), every_evals=2)
+    first = jax_backend.run(
+        cfg.replace(n_iterations=40), ds, f_opt, checkpoint=opts
+    )
+    resumed = jax_backend.run(
+        cfg, ds, f_opt,
+        checkpoint=CheckpointOptions(directory=str(tmp_path / "ck"),
+                                     every_evals=2, resume=True),
+    )
+    np.testing.assert_allclose(resumed.final_models, full.final_models,
+                               rtol=1e-12)
+    np.testing.assert_allclose(resumed.history.objective,
+                               full.history.objective, rtol=1e-12)
+
+
+def test_default_never_routes_to_chunk_loop(data):
+    """The chunk loop is opt-in only (measure_timestamps=True): its
+    per-eval host sync measured 311 vs 78,077 iters/sec on the tunneled
+    chip, so no default path may silently select it — the fused scan
+    (inline or hoisted) serves every cadence."""
+    ds, f_opt = data
+    cfg = CFG.replace(n_iterations=80, eval_every=2, scan_unroll=0)
+    assert not jax_backend.run(cfg, ds, f_opt).history.time_measured
+    assert not jax_backend.run(
+        cfg, ds, f_opt, collect_metrics=False
+    ).history.time_measured
+    assert jax_backend.run(
+        cfg, ds, f_opt, measure_timestamps=True
+    ).history.time_measured
