@@ -7,6 +7,22 @@ same linear operator has three interchangeable compiled forms:
 - ``dense``: an on-device matmul with the [N, N] mixing matrix. Works for any
   graph (Erdős–Rényi et al.). Under GSPMD sharding this becomes an
   all-gather + local contraction — fine for irregular graphs.
+- ``sparse`` (round 5): a CSR-style edge-list contraction for irregular
+  graphs — gather rows by edge source, scale by per-edge weight, and
+  ``jax.ops.segment_sum`` into edge destinations (edges pre-sorted by
+  destination host-side, so the segments are sorted). O(E·d) work instead
+  of the dense form's O(N²·d) — and MEASURED SLOWER than dense at every
+  cell tried (17 on-chip cells: N ∈ {256, 1024, 4096} × chain/star/ER/
+  directed-ER at densities 0.05%–40%, ``docs/perf/sparse_mixing.json``;
+  CPU spot-checks agree). On TPU the [N, N] matmul rides the MXU at a
+  ~40–90 µs latency floor through N=4096 while gather+scatter pays
+  per-row DMA that scales with E and catastrophically with density (200×
+  slower at 40%) — asymptotic sparsity arguments lose to the systolic
+  array at any scale a single chip holds. ``auto`` therefore keeps DENSE
+  for irregular graphs; ``sparse`` stays as an explicit opt-in (exact for
+  all graphs, directed included) for regimes beyond the measured envelope
+  (N >> 4096 multi-chip, where the [N, N] weight replication itself
+  becomes the bottleneck).
 - ``stencil``: for ring / torus / fully-connected graphs, where MH weights are
   uniform by symmetry, W x is a weighted sum of circular shifts of x along the
   worker axis (ring: ±1; torus: ±1 along each grid axis; fc: the global mean).
@@ -29,6 +45,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_optimization_tpu.parallel.topology import Topology
 
@@ -65,7 +82,10 @@ def make_mixing_op(topo: Topology, impl: str = "auto", dtype=jnp.float32) -> Mix
     """Build the compiled mixing operator for a topology.
 
     ``impl``: 'auto' picks 'stencil' where the graph embeds into the mesh as
-    shifts (ring/grid/fc), else 'dense'. 'shard_map' variants are built in
+    shifts (ring/grid/fc), else 'dense' — the measured winner for irregular
+    graphs at every cell tried, BOTH platforms (round 5,
+    ``docs/perf/sparse_mixing.json``; see the module docstring for the
+    mechanism). 'sparse' is opt-in only. 'shard_map' variants are built in
     ``parallel/collectives.py`` because they need a Mesh.
     """
     if impl == "auto":
@@ -75,7 +95,7 @@ def make_mixing_op(topo: Topology, impl: str = "auto", dtype=jnp.float32) -> Mix
             "shard_map mixing ops need a Mesh; build them via "
             "distributed_optimization_tpu.parallel.collectives instead"
         )
-    if impl not in ("dense", "stencil", "pallas"):
+    if impl not in ("dense", "stencil", "pallas", "sparse"):
         raise ValueError(f"Unknown mixing impl: {impl!r}")
     if impl == "stencil" and not _supports_stencil(topo):
         raise ValueError(f"stencil mixing unsupported for {topo.name} (n={topo.n})")
@@ -98,6 +118,44 @@ def make_mixing_op(topo: Topology, impl: str = "auto", dtype=jnp.float32) -> Mix
             f"pallas mixing supports ring (n>=3) and fully_connected, "
             f"not {topo.name} (n={topo.n})"
         )
+
+    if impl == "sparse":
+        # CSR edge-list contraction: works for ANY graph, directed included
+        # (the convention adjacency[i, j] = 1 iff j sends to i makes dst the
+        # receiving row for both orientations). np.nonzero walks row-major,
+        # so edges come out sorted by destination — segment_sum runs in its
+        # sorted fast path. Weights/edge lists are built host-side once; the
+        # device never materializes the [N, N] matrix.
+        dst_np, src_np = np.nonzero(topo.adjacency)
+        if dst_np.size == 0:
+            raise ValueError(
+                f"sparse mixing needs at least one edge ({topo.name}, "
+                f"n={topo.n})"
+            )
+        dst = jnp.asarray(dst_np, dtype=jnp.int32)
+        src = jnp.asarray(src_np, dtype=jnp.int32)
+        w_edge = jnp.asarray(
+            topo.mixing_matrix[dst_np, src_np], dtype=dtype
+        )
+        w_diag = jnp.asarray(np.diag(topo.mixing_matrix), dtype=dtype)
+        n = topo.n
+
+        def _bcast(v: jax.Array, x: jax.Array) -> jax.Array:
+            return v.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+
+        def apply(x: jax.Array) -> jax.Array:
+            gathered = _bcast(w_edge, x) * x[src]
+            agg = jax.ops.segment_sum(
+                gathered, dst, num_segments=n, indices_are_sorted=True
+            )
+            return (_bcast(w_diag, x) * x + agg).astype(x.dtype)
+
+        def neighbor_sum(x: jax.Array) -> jax.Array:
+            return jax.ops.segment_sum(
+                x[src], dst, num_segments=n, indices_are_sorted=True
+            ).astype(x.dtype)
+
+        return MixingOp(topo.name, "sparse", apply, neighbor_sum)
 
     if impl == "dense":
         W = jnp.asarray(topo.mixing_matrix, dtype=dtype)

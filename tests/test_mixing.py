@@ -57,6 +57,70 @@ def test_mixing_preserves_mean(rng, name, n):
     )
 
 
+SPARSE_CASES = [("erdos_renyi", 12), ("chain", 9), ("star", 9),
+                ("directed_erdos_renyi", 12), ("ring", 8)]
+
+
+@pytest.mark.parametrize("name,n", SPARSE_CASES)
+def test_sparse_equals_dense(rng, name, n):
+    """The CSR segment-sum contraction is the same linear operator as the
+    dense matmul, for undirected AND directed (column-stochastic) graphs."""
+    topo = build_topology(name, n, seed=2, erdos_renyi_p=0.35)
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    dense = make_mixing_op(topo, impl="dense")
+    sparse = make_mixing_op(topo, impl="sparse")
+    assert sparse.impl == "sparse"
+    np.testing.assert_allclose(
+        np.asarray(sparse.apply(jnp.asarray(x))),
+        np.asarray(dense.apply(jnp.asarray(x))),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sparse.neighbor_sum(jnp.asarray(x))),
+        np.asarray(dense.neighbor_sum(jnp.asarray(x))),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_sparse_handles_trailing_dims_and_jit(rng):
+    """[N]-trailing-shape variants (push-sum's [N, 1] mass) and jit both
+    work through the segment-sum path."""
+    import jax
+
+    topo = build_topology("erdos_renyi", 10, seed=4)
+    sparse = make_mixing_op(topo, impl="sparse")
+    w = rng.normal(size=(10, 1)).astype(np.float32)
+    expected = topo.mixing_matrix.astype(np.float32) @ w
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(sparse.apply)(jnp.asarray(w))), expected,
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_sparse_through_backend_matches_dense_run(rng):
+    """End-to-end: a backend run with mixing_impl='sparse' reproduces the
+    dense run's trajectory exactly (same linear operator, same batches)."""
+    from conftest import small_backend_config
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+    from distributed_optimization_tpu.utils.oracle import (
+        compute_reference_optimum,
+    )
+
+    cfg = small_backend_config(topology="erdos_renyi", n_iterations=40,
+                               dtype="float64")
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    rd = jax_backend.run(cfg.replace(mixing_impl="dense"), ds, f_opt)
+    rs = jax_backend.run(cfg.replace(mixing_impl="sparse"), ds, f_opt)
+    np.testing.assert_allclose(rs.final_models, rd.final_models, rtol=1e-10)
+    np.testing.assert_allclose(
+        rs.history.objective, rd.history.objective, rtol=1e-9
+    )
+
+
 def test_stencil_rejected_for_irregular_graph():
     topo = build_topology("erdos_renyi", 10, seed=0)
     with pytest.raises(ValueError):
@@ -68,59 +132,31 @@ def test_auto_picks_stencil_for_regular_graphs():
     assert make_mixing_op(build_topology("erdos_renyi", 8, seed=0)).impl == "dense"
 
 
-def test_auto_impl_resolution_uses_measured_tpu_winner():
-    """auto -> pallas exactly where examples/bench_pallas_regimes.py measured
-    the win: single-chip TPU, dsgd on a static synchronous ring, float32,
-    AND a wide model dimension (d >= PALLAS_MIN_DIM — at the headline d=81
-    the XLA stencil measured ahead in round 3)."""
-    from distributed_optimization_tpu.algorithms import get_algorithm
-    from distributed_optimization_tpu.backends.jax_backend import (
-        PALLAS_MIN_DIM,
-        _resolve_auto_mixing_impl,
-    )
-    from distributed_optimization_tpu.config import ExperimentConfig
+def test_sparse_is_opt_in_only():
+    """docs/perf/sparse_mixing.json measured DENSE faster than the CSR
+    form at every cell (N up to 4096, densities 0.05%-40%, both
+    platforms), so auto keeps dense for irregular graphs at any scale and
+    sparse is explicit opt-in."""
+    assert make_mixing_op(build_topology("chain", 128)).impl == "dense"
+    assert make_mixing_op(build_topology("chain", 16)).impl == "dense"
+    assert make_mixing_op(
+        build_topology("erdos_renyi", 128, seed=0, erdos_renyi_p=0.05)
+    ).impl == "dense"
+    # Regular graphs keep their stencils at any N.
+    assert make_mixing_op(build_topology("ring", 256)).impl == "stencil"
+    assert make_mixing_op(
+        build_topology("chain", 128), impl="sparse"
+    ).impl == "sparse"
 
-    wide = PALLAS_MIN_DIM + 63
-    cfg = ExperimentConfig(algorithm="dsgd", topology="ring", n_workers=8,
-                           n_features=wide, n_informative_features=8)
-    topo = build_topology("ring", 8)
-    dsgd = get_algorithm("dsgd")
 
-    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "tpu", wide + 1) == "pallas"
-    # The headline shape (d=81): stencil measured ahead post-flat-scan.
-    # The dimension is the DATASET's, not the config's (digits ignores
-    # config.n_features).
-    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "tpu", 81) == "auto"
-
-    # Outside the measured envelope: fall through to the stencil/dense rule.
-    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, None, "cpu", wide + 1) == "auto"
-    assert _resolve_auto_mixing_impl(cfg, topo, dsgd, object(), "tpu", wide + 1) == "auto"
-    assert (
-        _resolve_auto_mixing_impl(
-            cfg.replace(edge_drop_prob=0.1), topo, dsgd, None, "tpu", wide + 1
-        )
-        == "auto"
-    )
-    assert (
-        _resolve_auto_mixing_impl(
-            cfg.replace(dtype="bfloat16"), topo, dsgd, None, "tpu", wide + 1
-        )
-        == "auto"
-    )
-    gt = get_algorithm("gradient_tracking")
-    assert _resolve_auto_mixing_impl(cfg, topo, gt, None, "tpu", wide + 1) == "auto"
-    grid = build_topology("grid", 9)
-    assert (
-        _resolve_auto_mixing_impl(
-            cfg.replace(topology="grid", n_workers=9), grid, dsgd, None,
-            "tpu", wide + 1
-        )
-        == "auto"
-    )
-    # Explicit impls pass through untouched.
-    assert (
-        _resolve_auto_mixing_impl(
-            cfg.replace(mixing_impl="dense"), topo, dsgd, None, "tpu", wide + 1
-        )
-        == "dense"
-    )
+def test_auto_never_picks_pallas_after_round5_sweep():
+    """Round 5's interleaved 7-dim sweep (docs/perf/pallas_regimes.json)
+    found NO reproducible pallas win at any d in [81, 1024] (e2e ratios
+    0.78-1.29, no trend; the round-3 d=1024 win did not replicate), so
+    'auto' never resolves to the VMEM kernels — stencil/dense only — at
+    any dimension, and pallas is explicit opt-in."""
+    for n in (8, 256):
+        assert make_mixing_op(build_topology("ring", n)).impl == "stencil"
+    assert make_mixing_op(
+        build_topology("ring", 8), impl="pallas"
+    ).impl == "pallas"
