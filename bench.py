@@ -225,7 +225,7 @@ def main() -> None:
     # capture means "different machine", not "docs went stale". Setting
     # BENCH_NO_RANGE_CHECK=1 skips ONLY these two gates — convergence gates
     # above still apply and the session record is still printed.
-    if os.environ.get("BENCH_NO_RANGE_CHECK"):
+    if os.environ.get("BENCH_NO_RANGE_CHECK", "").lower() not in ("", "0", "false"):
         print(
             "[bench] BENCH_NO_RANGE_CHECK set: skipping published-range and "
             "floor-ratio gates (non-canonical hardware mode)",
