@@ -6,7 +6,9 @@ reference ``trainer.py:7-74``/``76-197``) plus matrix/node-form recursions
 of the extensions (DIGing gradient tracking, EXTRA, DLM decentralized ADMM,
 CHOCO-SGD with deterministic compressors, and push-sum SGP over directed
 graphs — the same recursions the numpy oracle implements, giving a third
-independent implementation for cross-tier verification), compiled from
+independent implementation for cross-tier verification; round 5 adds the
+softmax family, whose flat [d·K] matrix parameters flow through every
+recursion unchanged), compiled from
 ``native/src/gossip_core.cpp`` into a shared library (OpenMP-parallel
 worker loop, stable closed-form objectives). Fidelity-sensitive work stays on
 the numpy oracle (exact reference semantics, injectable batches); this tier
@@ -92,7 +94,8 @@ def load_library(rebuild: bool = False) -> ctypes.CDLL:
     lib.run_simulation.restype = ctypes.c_int
     lib.run_simulation.argtypes = [
         f64p, f64p, i64p,                      # X, y, offsets
-        ctypes.c_int64, ctypes.c_int64, f64p,  # n_workers, d, W
+        ctypes.c_int64, ctypes.c_int64,        # n_workers, d
+        ctypes.c_int64, f64p,                  # n_classes (1 = scalar), W
         ctypes.c_int, ctypes.c_int,            # algorithm, problem
         ctypes.c_int64, ctypes.c_int64,        # T, batch_size
         ctypes.c_double, ctypes.c_int,         # eta0, sqrt_decay
@@ -121,13 +124,6 @@ def run(
             "algorithms plus matrix/node-form GT/EXTRA/ADMM/CHOCO); "
             f"{config.algorithm!r} is a jax-backend capability"
         )
-    if config.problem_type == "softmax":
-        raise ValueError(
-            "the native core's C ABI models per-worker parameters as "
-            "d-vectors with scalar-output GLM kernels (gossip_core.cpp); "
-            "softmax — the compute-bound matrix-parameter tier — is a "
-            "jax/numpy-backend capability"
-        )
     if (
         config.edge_drop_prob > 0.0
         or config.straggler_prob > 0.0
@@ -146,6 +142,12 @@ def run(
 
     n = config.n_workers
     d = dataset.n_features
+    # Trained parameter dimension: the softmax family's flat [d·K] matrix
+    # (class labels travel in the float64 y array — exact for any K),
+    # n_features for the scalar GLMs. Mirrors the jax backend's
+    # problem.param_dim and the numpy oracle's branch.
+    n_classes = config.n_classes if config.problem_type == "softmax" else 1
+    d_model = d * n_classes
     T = config.n_iterations
     eval_every = config.eval_every
     n_evals = T // eval_every
@@ -161,7 +163,7 @@ def run(
 
     if centralized:
         W = np.zeros((1, 1), dtype=np.float64)
-        floats_per_iter = centralized_floats_per_iteration(n, d)
+        floats_per_iter = centralized_floats_per_iteration(n, d_model)
         spectral_gap = None
     else:
         from distributed_optimization_tpu.algorithms import get_algorithm
@@ -176,25 +178,26 @@ def run(
             # Compressed gossip transmits the compressor's payload per edge
             # (same accounting as the jax and numpy backends).
             floats_per_iter = topo.floats_per_iteration * algo.comm_payload(
-                config, d
+                config, d_model
             )
         else:
             # GT gossips both x and y per iteration (gossip_rounds=2).
             floats_per_iter = decentralized_floats_per_iteration(
-                topo, d, algo.gossip_rounds
+                topo, d_model, algo.gossip_rounds
             )
         spectral_gap = topo.spectral_gap
 
-    out_models = np.zeros((n, d), dtype=np.float64)
+    out_models = np.zeros((n, d_model), dtype=np.float64)
     out_gap = np.full(n_evals, np.nan)
     out_cons = np.full(n_evals, np.nan)
     out_times = np.full(n_evals, np.nan)
 
     start = time.perf_counter()
     rc = lib.run_simulation(
-        X, y, offsets, n, d, W,
+        X, y, offsets, n, d, n_classes, W,
         _ALGO_CODES[config.algorithm],
-        {"logistic": 0, "quadratic": 1, "huber": 2}[config.problem_type],
+        {"logistic": 0, "quadratic": 1, "huber": 2,
+         "softmax": 3}[config.problem_type],
         T, config.local_batch_size,
         config.learning_rate_eta0,
         1 if config.resolved_lr_schedule() == "sqrt_decay" else 0,

@@ -89,8 +89,14 @@ void sample_without_replacement(Xoshiro256ss &rng, int64_t n, int64_t b,
 constexpr int kLogistic = 0;
 constexpr int kQuadratic = 1;
 constexpr int kHuber = 2;
+constexpr int kSoftmax = 3;
 // The Huber transition point delta is a run_simulation argument (single
 // source: config.DEFAULT_HUBER_DELTA on the Python side) — no baked-in copy.
+// Softmax (round 5): multinomial logistic with a [d, K] weight matrix
+// stored flat row-major (w[j*K + k], matching the Python tiers'
+// w.reshape(d, K)); labels are class indices carried in the y doubles
+// (exact in float64). The MODEL length is d*K while data rows stay d —
+// the driver below threads both (`dm` vs `d`).
 
 inline double dot(const double *a, const double *b, int64_t d) {
   double acc = 0.0;
@@ -98,47 +104,101 @@ inline double dot(const double *a, const double *b, int64_t d) {
   return acc;
 }
 
+// logits[k] = sum_j x[j] * w[j*K + k]; returns nothing, fills K slots.
+inline void softmax_logits(const double *xi, const double *w, int64_t d,
+                           int64_t K, double *logits) {
+  std::memset(logits, 0, sizeof(double) * K);
+  for (int64_t j = 0; j < d; ++j) {
+    const double xj = xi[j];
+    if (xj == 0.0) continue;
+    const double *wj = w + j * K;
+    for (int64_t k = 0; k < K; ++k) logits[k] += xj * wj[k];
+  }
+}
+
 // Full-dataset objective: mean loss + (reg/2)||w||^2 (losses_np parity).
+// `dm` = model length (d for scalar GLMs, d*K for softmax).
 double full_objective(int problem, const double *X, const double *y,
-                      int64_t n, int64_t d, const double *w, double reg,
-                      double huber_delta) {
+                      int64_t n, int64_t d, int64_t n_classes, int64_t dm,
+                      const double *w, double reg, double huber_delta) {
   double acc = 0.0;
+  if (problem == kSoftmax) {
+    const int64_t K = n_classes;
+#pragma omp parallel
+    {
+      std::vector<double> logits(K);
+#pragma omp for reduction(+ : acc) schedule(static)
+      for (int64_t i = 0; i < n; ++i) {
+        softmax_logits(X + i * d, w, d, K, logits.data());
+        double m = logits[0];
+        for (int64_t k = 1; k < K; ++k) m = std::max(m, logits[k]);
+        double se = 0.0;
+        for (int64_t k = 0; k < K; ++k) se += std::exp(logits[k] - m);
+        const auto yi = static_cast<int64_t>(y[i]);
+        acc += m + std::log(se) - logits[yi];
+      }
+    }
+  } else {
 #pragma omp parallel for reduction(+ : acc) schedule(static)
-  for (int64_t i = 0; i < n; ++i) {
-    double z = dot(X + i * d, w, d);
-    if (problem == kLogistic) {
-      double yz = y[i] * z;
-      // stable log(1 + exp(-yz)) = max(0, -yz) + log1p(exp(-|yz|))
-      double m = yz < 0.0 ? -yz : 0.0;
-      acc += m + std::log1p(std::exp(-std::fabs(yz)));
-    } else if (problem == kQuadratic) {
-      double r = z - y[i];
-      acc += 0.5 * r * r;
-    } else {  // kHuber
-      double r = z - y[i];
-      double a = std::fabs(r);
-      acc += a <= huber_delta ? 0.5 * r * r
-                              : huber_delta * (a - 0.5 * huber_delta);
+    for (int64_t i = 0; i < n; ++i) {
+      double z = dot(X + i * d, w, d);
+      if (problem == kLogistic) {
+        double yz = y[i] * z;
+        // stable log(1 + exp(-yz)) = max(0, -yz) + log1p(exp(-|yz|))
+        double m = yz < 0.0 ? -yz : 0.0;
+        acc += m + std::log1p(std::exp(-std::fabs(yz)));
+      } else if (problem == kQuadratic) {
+        double r = z - y[i];
+        acc += 0.5 * r * r;
+      } else {  // kHuber
+        double r = z - y[i];
+        double a = std::fabs(r);
+        acc += a <= huber_delta ? 0.5 * r * r
+                                : huber_delta * (a - 0.5 * huber_delta);
+      }
     }
   }
   double obj = acc / static_cast<double>(n);
-  obj += 0.5 * reg * dot(w, w, d);
+  obj += 0.5 * reg * dot(w, w, dm);
   return obj;
 }
 
 // Stochastic gradient over batch rows `idx` of one worker's shard.
+// g_out has `dm` slots; `logits` is caller-provided [K] scratch (softmax).
 void stochastic_gradient(int problem, const double *Xs, const double *ys,
-                         int64_t d, const std::vector<int64_t> &idx,
+                         int64_t d, int64_t n_classes, int64_t dm,
+                         const std::vector<int64_t> &idx,
                          const double *w, double reg, double huber_delta,
-                         double *g_out) {
-  std::memset(g_out, 0, sizeof(double) * d);
+                         std::vector<double> &logits, double *g_out) {
+  std::memset(g_out, 0, sizeof(double) * dm);
   const auto b = static_cast<int64_t>(idx.size());
   if (b == 0) {
-    for (int64_t k = 0; k < d; ++k) g_out[k] = reg * w[k];
+    for (int64_t k = 0; k < dm; ++k) g_out[k] = reg * w[k];
     return;
   }
   for (int64_t t = 0; t < b; ++t) {
     const double *xi = Xs + idx[t] * d;
+    if (problem == kSoftmax) {
+      const int64_t K = n_classes;
+      softmax_logits(xi, w, d, K, logits.data());
+      double m = logits[0];
+      for (int64_t k = 1; k < K; ++k) m = std::max(m, logits[k]);
+      double se = 0.0;
+      for (int64_t k = 0; k < K; ++k) {
+        logits[k] = std::exp(logits[k] - m);
+        se += logits[k];
+      }
+      const double inv_se = 1.0 / se;
+      for (int64_t k = 0; k < K; ++k) logits[k] *= inv_se;  // now P
+      logits[static_cast<int64_t>(ys[idx[t]])] -= 1.0;      // P - onehot
+      for (int64_t j = 0; j < d; ++j) {
+        const double xj = xi[j];
+        if (xj == 0.0) continue;
+        double *gj = g_out + j * K;
+        for (int64_t k = 0; k < K; ++k) gj[k] += xj * logits[k];
+      }
+      continue;
+    }
     double z = dot(xi, w, d);
     double coef;
     if (problem == kLogistic) {
@@ -156,7 +216,7 @@ void stochastic_gradient(int problem, const double *Xs, const double *ys,
     for (int64_t k = 0; k < d; ++k) g_out[k] += coef * xi[k];
   }
   double inv_b = 1.0 / static_cast<double>(b);
-  for (int64_t k = 0; k < d; ++k) g_out[k] = g_out[k] * inv_b + reg * w[k];
+  for (int64_t k = 0; k < dm; ++k) g_out[k] = g_out[k] * inv_b + reg * w[k];
 }
 
 }  // namespace
@@ -165,7 +225,11 @@ extern "C" {
 
 // Shared driver for all six algorithms.
 //
-// X, y: concatenated per-worker shards, [n_total, d] row-major / [n_total];
+// X, y: concatenated per-worker shards, [n_total, d] row-major / [n_total]
+//       (softmax labels are class indices carried in the y doubles);
+// n_classes: 1 for the scalar GLMs; K >= 2 for softmax (problem 3), whose
+//       model rows are flat [d*K] matrices (out_models is then
+//       [n_workers, d*K]);
 // offsets: [n_workers + 1] shard boundaries into X/y rows;
 // W: [n_workers, n_workers] dense mixing matrix (ignored when centralized);
 // algorithm: 0 = centralized (parameter-server SGD), 1 = D-SGD,
@@ -201,7 +265,8 @@ extern "C" {
 //            trainer.py:63,181).
 // Returns 0 on success, nonzero on invalid arguments.
 int run_simulation(const double *X, const double *y, const int64_t *offsets,
-                   int64_t n_workers, int64_t d, const double *W,
+                   int64_t n_workers, int64_t d, int64_t n_classes,
+                   const double *W,
                    int algorithm, int problem, int64_t T,
                    int64_t batch_size, double eta0, int sqrt_decay,
                    double reg, double huber_delta,
@@ -217,23 +282,40 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       T % eval_every != 0 || batch_size < 0) {
     return 1;
   }
-  if (problem < kLogistic || problem > kHuber) return 2;
+  if (problem < kLogistic || problem > kSoftmax) return 2;
   if (problem == kHuber && huber_delta <= 0.0) return 2;
+  if (problem == kSoftmax && n_classes < 2) return 2;
+  if (problem != kSoftmax && n_classes != 1) return 2;
+  if (problem == kSoftmax) {
+    // Labels index the [K] logits buffer; an out-of-range label would be
+    // an out-of-bounds write in the gradient kernel. Validate up front
+    // (the numpy tier raises IndexError for the same input).
+    const int64_t nt = offsets[n_workers];
+    for (int64_t i = 0; i < nt; ++i) {
+      const auto yi = static_cast<int64_t>(y[i]);
+      if (yi < 0 || yi >= n_classes) return 2;
+    }
+  }
   if (algorithm < kCentralized || algorithm > kPushSum) return 3;
+  const bool centralized = algorithm == kCentralized;
+  const int64_t n_total = offsets[n_workers];
+  // Model row length: d for scalar GLMs, the flat d*K matrix for softmax
+  // (data rows stay d wide — only the objective/gradient kernels bridge
+  // the two shapes; every algorithm recursion is elementwise/mixing over
+  // model coordinates, so it runs unchanged over dm).
+  const int64_t dm = problem == kSoftmax ? d * n_classes : d;
+  const int64_t nd = n_workers * dm;
   if (algorithm == kAdmm && (admm_c <= 0.0 || admm_rho <= 0.0)) return 4;
   if (algorithm == kChoco &&
       (choco_gamma <= 0.0 || compression < 0 || compression > 1 ||
-       (compression == 1 && (comp_k <= 0 || comp_k > d)))) {
+       (compression == 1 && (comp_k <= 0 || comp_k > dm)))) {
     return 5;
   }
-  const bool centralized = algorithm == kCentralized;
-  const int64_t n_total = offsets[n_workers];
-  const int64_t nd = n_workers * d;
 
   std::vector<double> models(nd, 0.0);
   std::vector<double> grads(nd, 0.0);
   std::vector<double> mixed(nd, 0.0);
-  std::vector<double> avg(d, 0.0);
+  std::vector<double> avg(dm, 0.0);
   // Extension state (allocated only when used).
   std::vector<double> y_trk, g_prev, x_prev, Wx_prev, Wy;
   std::vector<double> adj, deg, alpha, nbr;
@@ -284,6 +366,7 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
 #pragma omp parallel
     {
       std::vector<int64_t> scratch, idx;
+      std::vector<double> logits(problem == kSoftmax ? n_classes : 0);
 #pragma omp for schedule(static)
       for (int64_t i = 0; i < n_workers; ++i) {
         const int64_t lo = offsets[i], hi = offsets[i + 1];
@@ -296,10 +379,10 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
         } else {
           idx.clear();
         }
-        const double *params = shared ? at : at + i * d;
-        stochastic_gradient(problem, X + lo * d, y + lo, d, idx, params, reg,
-                            huber_delta,
-                            grads.data() + i * d);
+        const double *params = shared ? at : at + i * dm;
+        stochastic_gradient(problem, X + lo * d, y + lo, d, n_classes,
+                            dm, idx, params, reg, huber_delta,
+                            logits, grads.data() + i * dm);
       }
     }
   };
@@ -309,13 +392,13 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
                        std::vector<double> &out) {
 #pragma omp parallel for schedule(static)
     for (int64_t i = 0; i < n_workers; ++i) {
-      double *oi = out.data() + i * d;
-      std::memset(oi, 0, sizeof(double) * d);
+      double *oi = out.data() + i * dm;
+      std::memset(oi, 0, sizeof(double) * dm);
       for (int64_t j = 0; j < n_workers; ++j) {
         const double w_ij = mat[i * n_workers + j];
         if (w_ij == 0.0) continue;
-        const double *xj = in.data() + j * d;
-        for (int64_t k = 0; k < d; ++k) oi[k] += w_ij * xj[k];
+        const double *xj = in.data() + j * dm;
+        for (int64_t k = 0; k < dm; ++k) oi[k] += w_ij * xj[k];
       }
     }
   };
@@ -333,9 +416,9 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       compute_grads(models.data(), /*shared=*/true, t);
       // psum-mean of worker gradients, step the (shared) row-0 model.
       for (int64_t i = 1; i < n_workers; ++i)
-        for (int64_t k = 0; k < d; ++k) grads[k] += grads[i * d + k];
+        for (int64_t k = 0; k < dm; ++k) grads[k] += grads[i * dm + k];
       const double inv_n = 1.0 / static_cast<double>(n_workers);
-      for (int64_t k = 0; k < d; ++k)
+      for (int64_t k = 0; k < dm; ++k)
         models[k] -= eta * grads[k] * inv_n;
     } else if (algorithm == kDsgd) {
       // D-PSGD: grads at local x_t (pre-mix), x_{t+1} = W x_t - eta g_t.
@@ -343,9 +426,9 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       apply_W(models, mixed);
 #pragma omp parallel for schedule(static)
       for (int64_t i = 0; i < n_workers; ++i) {
-        double *mi = mixed.data() + i * d;
-        const double *gi = grads.data() + i * d;
-        for (int64_t k = 0; k < d; ++k) mi[k] -= eta * gi[k];
+        double *mi = mixed.data() + i * dm;
+        const double *gi = grads.data() + i * dm;
+        for (int64_t k = 0; k < dm; ++k) mi[k] -= eta * gi[k];
       }
       models.swap(mixed);
     } else if (algorithm == kGT) {
@@ -374,16 +457,16 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
         std::vector<int64_t> order;
 #pragma omp for schedule(static)
         for (int64_t i = 0; i < n_workers; ++i) {
-          double *hi = x_half.data() + i * d;
-          const double *xi = models.data() + i * d;
-          const double *gi = grads.data() + i * d;
-          double *xh = xhat.data() + i * d;
-          for (int64_t k = 0; k < d; ++k) hi[k] = xi[k] - eta * gi[k];
+          double *hi = x_half.data() + i * dm;
+          const double *xi = models.data() + i * dm;
+          const double *gi = grads.data() + i * dm;
+          double *xh = xhat.data() + i * dm;
+          for (int64_t k = 0; k < dm; ++k) hi[k] = xi[k] - eta * gi[k];
           if (compression == 0) {
-            for (int64_t k = 0; k < d; ++k) xh[k] = hi[k];
+            for (int64_t k = 0; k < dm; ++k) xh[k] = hi[k];
           } else {
-            order.resize(d);
-            for (int64_t k = 0; k < d; ++k) order[k] = k;
+            order.resize(dm);
+            for (int64_t k = 0; k < dm; ++k) order[k] = k;
             // Stable descending sort by |x_half − x̂|; take the first k.
             std::stable_sort(order.begin(), order.end(),
                              [&](int64_t a, int64_t b) {
@@ -398,11 +481,11 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       apply_W(xhat, Wxhat);
 #pragma omp parallel for schedule(static)
       for (int64_t i = 0; i < n_workers; ++i) {
-        double *xi = models.data() + i * d;
-        const double *hi = x_half.data() + i * d;
-        const double *wi = Wxhat.data() + i * d;
-        const double *xh = xhat.data() + i * d;
-        for (int64_t k = 0; k < d; ++k)
+        double *xi = models.data() + i * dm;
+        const double *hi = x_half.data() + i * dm;
+        const double *wi = Wxhat.data() + i * dm;
+        const double *xh = xhat.data() + i * dm;
+        for (int64_t k = 0; k < dm; ++k)
           xi[k] = hi[k] + choco_gamma * (wi[k] - xh[k]);
       }
     } else if (algorithm == kPushSum) {
@@ -427,9 +510,9 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
 #pragma omp parallel for schedule(static)
       for (int64_t i = 0; i < n_workers; ++i) {
         const double inv_w = 1.0 / wmass[i];
-        double *zi = models.data() + i * d;
-        const double *ni = num.data() + i * d;
-        for (int64_t k = 0; k < d; ++k) zi[k] = ni[k] * inv_w;
+        double *zi = models.data() + i * dm;
+        const double *ni = num.data() + i * dm;
+        for (int64_t k = 0; k < dm; ++k) zi[k] = ni[k] * inv_w;
       }
     } else if (algorithm == kAdmm) {
       // DLM (Ling et al. '15), node form — same recursion as
@@ -443,12 +526,12 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       for (int64_t i = 0; i < n_workers; ++i) {
         const double di = deg[i];
         const double inv_denom = 1.0 / (admm_rho + admm_c * di);
-        double *mi = mixed.data() + i * d;
-        const double *xi = models.data() + i * d;
-        const double *gi = grads.data() + i * d;
-        const double *ai = alpha.data() + i * d;
-        const double *ni = nbr.data() + i * d;
-        for (int64_t k = 0; k < d; ++k) {
+        double *mi = mixed.data() + i * dm;
+        const double *xi = models.data() + i * dm;
+        const double *gi = grads.data() + i * dm;
+        const double *ai = alpha.data() + i * dm;
+        const double *ni = nbr.data() + i * dm;
+        for (int64_t k = 0; k < dm; ++k) {
           mi[k] = (admm_rho * xi[k] + 0.5 * admm_c * (di * xi[k] + ni[k]) -
                    gi[k] - ai[k]) *
                   inv_denom;
@@ -459,10 +542,10 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
 #pragma omp parallel for schedule(static)
       for (int64_t i = 0; i < n_workers; ++i) {
         const double di = deg[i];
-        double *ai = alpha.data() + i * d;
-        const double *xi = models.data() + i * d;
-        const double *ni = nbr.data() + i * d;
-        for (int64_t k = 0; k < d; ++k)
+        double *ai = alpha.data() + i * dm;
+        const double *xi = models.data() + i * dm;
+        const double *ni = nbr.data() + i * dm;
+        for (int64_t k = 0; k < dm; ++k)
           ai[k] += 0.5 * admm_c * (di * xi[k] - ni[k]);
       }
     } else {  // kExtra
@@ -496,20 +579,20 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
       if (!collect_metrics) {
         // objective/consensus evaluation skipped; timestamp still stamped
       } else if (centralized) {
-        out_gap[row] = full_objective(problem, X, y, n_total, d,
-                                      models.data(), reg, huber_delta);
+        out_gap[row] = full_objective(problem, X, y, n_total, d, n_classes,
+                                      dm, models.data(), reg, huber_delta);
       } else {  // decentralized metrics
-        std::memset(avg.data(), 0, sizeof(double) * d);
+        std::memset(avg.data(), 0, sizeof(double) * dm);
         for (int64_t i = 0; i < n_workers; ++i)
-          for (int64_t k = 0; k < d; ++k) avg[k] += models[i * d + k];
+          for (int64_t k = 0; k < dm; ++k) avg[k] += models[i * dm + k];
         const double inv_n = 1.0 / static_cast<double>(n_workers);
-        for (int64_t k = 0; k < d; ++k) avg[k] *= inv_n;
-        out_gap[row] = full_objective(problem, X, y, n_total, d,
-                                      avg.data(), reg, huber_delta);
+        for (int64_t k = 0; k < dm; ++k) avg[k] *= inv_n;
+        out_gap[row] = full_objective(problem, X, y, n_total, d, n_classes,
+                                      dm, avg.data(), reg, huber_delta);
         double ce = 0.0;
         for (int64_t i = 0; i < n_workers; ++i) {
-          const double *xi = models.data() + i * d;
-          for (int64_t k = 0; k < d; ++k) {
+          const double *xi = models.data() + i * dm;
+          for (int64_t k = 0; k < dm; ++k) {
             const double diff = xi[k] - avg[k];
             ce += diff * diff;
           }
@@ -528,9 +611,9 @@ int run_simulation(const double *X, const double *y, const int64_t *offsets,
 
   if (centralized) {
     for (int64_t i = 0; i < n_workers; ++i)
-      std::memcpy(out_models + i * d, models.data(), sizeof(double) * d);
+      std::memcpy(out_models + i * dm, models.data(), sizeof(double) * dm);
   } else {
-    std::memcpy(out_models, models.data(), sizeof(double) * n_workers * d);
+    std::memcpy(out_models, models.data(), sizeof(double) * n_workers * dm);
   }
   return 0;
 }

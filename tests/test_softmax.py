@@ -158,12 +158,69 @@ def test_digits_multiclass():
         generate_digits_dataset(cfg.replace(n_classes=5))
 
 
-def test_cpp_backend_rejects_softmax(sm_setup):
-    from distributed_optimization_tpu.backends import cpp_backend
-
+def test_cpp_backend_matches_numpy_oracle(sm_setup):
+    """Three-tier parity (round 5): the native core's softmax kernels —
+    flat [d*K] model rows, labels as class indices in the y doubles —
+    reproduce the independent numpy matrix recursions to machine
+    precision on deterministic full-batch runs."""
+    cpp_backend = pytest.importorskip(
+        "distributed_optimization_tpu.backends.cpp_backend"
+    )
+    try:
+        cpp_backend.load_library()
+    except cpp_backend.NativeBuildError:  # pragma: no cover
+        pytest.skip("native toolchain unavailable")
     cfg, ds, _, f_opt = sm_setup
-    with pytest.raises(ValueError, match="jax/numpy-backend capability"):
-        cpp_backend.run(cfg, ds, f_opt)
+    full = cfg.replace(local_batch_size=10_000, n_iterations=120,
+                       eval_every=20)
+    # ALL SEVEN algorithm recursions: the dm-threading (flat [d*K] model
+    # rows) touches every branch, and these shapes only occur with softmax
+    # (scalar GLMs always run dm == d). choco exercises the relaxed
+    # comp_k <= d*K top-k bound with a support wider than d.
+    for algo in ("dsgd", "gradient_tracking", "extra", "admm", "choco",
+                 "push_sum", "centralized"):
+        kw = dict(algorithm=algo)
+        if algo == "push_sum":
+            kw["topology"] = "directed_erdos_renyi"
+        if algo == "choco":
+            kw.update(compression="top_k",
+                      compression_k=ds.n_features + 7)  # > d, < d*K
+        c = full.replace(**kw)
+        rc = cpp_backend.run(c, ds, f_opt)
+        rn = numpy_backend.run(c, ds, f_opt)
+        np.testing.assert_allclose(rc.final_models, rn.final_models,
+                                   atol=1e-12)
+        np.testing.assert_allclose(rc.history.objective,
+                                   rn.history.objective, atol=1e-12)
+        assert (
+            rc.history.total_floats_transmitted
+            == rn.history.total_floats_transmitted
+        )
+
+
+def test_cpp_rejects_out_of_range_labels(sm_setup):
+    """An out-of-range class label would index past the native logits
+    buffer (a heap write); the core must reject it up front like the
+    numpy tier's IndexError."""
+    from distributed_optimization_tpu.utils.data import HostDataset
+
+    cpp_backend = pytest.importorskip(
+        "distributed_optimization_tpu.backends.cpp_backend"
+    )
+    try:
+        cpp_backend.load_library()
+    except cpp_backend.NativeBuildError:  # pragma: no cover
+        pytest.skip("native toolchain unavailable")
+    cfg, ds, _, f_opt = sm_setup
+    bad = HostDataset(
+        X_full=ds.X_full,
+        y_full=np.full_like(ds.y_full, cfg.n_classes),  # == K: out of range
+        shard_indices=ds.shard_indices,
+        problem_type="softmax",
+    )
+    with pytest.raises(RuntimeError, match="rejected"):
+        cpp_backend.run(cfg.replace(n_iterations=10, eval_every=10),
+                        bad, f_opt)
 
 
 def test_labels_stay_exact_under_bfloat16():
