@@ -69,6 +69,24 @@ PRESETS: dict[str, dict] = {
     # et al. '19). Measured in docs/perf/presets.json like the others.
     "push-sum-der-16": dict(problem_type="logistic", algorithm="push_sum",
                             topology="directed_erdos_renyi", n_workers=16),
+    # 7. Multiclass softmax on the real digits images (round 5; beyond
+    # BASELINE.json) — the ten digit classes ARE the labels, so this is
+    # the natural multiclass form of the stretch config: a [65, 10]
+    # weight matrix per worker gossiped as a flat 650-vector.
+    "digits-softmax-64": dict(problem_type="softmax", n_classes=10,
+                              algorithm="dsgd", topology="ring",
+                              n_workers=64, dataset="digits",
+                              learning_rate_eta0=0.1),
+    # 8. The compute-bound tier at CLI scale (round 5): wide softmax whose
+    # gradients are real MXU matmuls — a small sibling of
+    # examples/bench_compute_bound.py's measured cells
+    # (docs/perf/compute_bound.json: 33-36% median MFU at d in
+    # {4096, 8192}, K=512, bf16).
+    "softmax-mxu-8": dict(problem_type="softmax", n_classes=128,
+                          algorithm="dsgd", topology="ring", n_workers=8,
+                          n_features=1024, n_informative_features=64,
+                          n_samples=2048, local_batch_size=256,
+                          learning_rate_eta0=0.1, n_iterations=2000),
 }
 
 

@@ -64,7 +64,14 @@ def main() -> None:
         cfg = ExperimentConfig(**overrides)
         ds = (generate_digits_dataset(cfg) if dataset_kind == "digits"
               else generate_synthetic_dataset(cfg))
-        _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+        # Thread the problem-binding knobs like simulator.py does: the
+        # softmax oracle must solve the CONFIGURED K (inferring max(y)+1
+        # from a draw with unrealized classes would yield a smaller-K
+        # optimum and wrong gaps), and huber's optimum depends on delta.
+        _, f_opt = compute_reference_optimum(
+            ds, cfg.reg_param, huber_delta=cfg.huber_delta,
+            n_classes=cfg.n_classes,
+        )
         r = jax_backend.run(cfg, ds, f_opt)
         h = r.history
         crossed = iterations_to_threshold(

@@ -66,7 +66,8 @@ def test_presets_cover_baseline_configs(tmp_path):
 
     assert set(PRESETS) == {
         "quadratic-fc-4", "logistic-ring-8", "admm-er-16", "gt-torus-64",
-        "digits-64", "push-sum-der-16",
+        "digits-64", "push-sum-der-16", "digits-softmax-64",
+        "softmax-mxu-8",
     }
     # Preset end-to-end (tiny horizon), with an explicit flag overriding it.
     json_out = tmp_path / "p.json"
@@ -151,4 +152,27 @@ def test_measure_time_flags(tmp_path, capsys):
     assert "always" in capsys.readouterr().err
     # positive flag is a harmless no-op on the already-measured backends
     rc = main(_TINY + ["--backend", "numpy", "--measure-time"])
+    assert rc == 0
+
+
+def test_preset_digits_softmax(tmp_path):
+    """Round-5 preset: real ten-class digits through the softmax family —
+    the [65, 10] weight matrix travels as a flat 650-vector."""
+    json_out = tmp_path / "dsm.json"
+    rc = main(["--preset", "digits-softmax-64", "--n-iterations", "30",
+               "--quiet", "--json", str(json_out)])
+    assert rc == 0
+    blob = json.loads(json_out.read_text())
+    assert blob["config"]["problem_type"] == "softmax"
+    assert blob["config"]["n_classes"] == 10
+    assert np.all(np.isfinite(blob["runs"][0]["history"]["objective"]))
+
+
+def test_preset_softmax_mxu(tmp_path):
+    """Round-5 compute-tier preset (shrunk): the wide-softmax config whose
+    full-size cells are the measured MFU artifact."""
+    rc = main(["--preset", "softmax-mxu-8", "--n-iterations", "20",
+               "--eval-every", "20", "--n-features", "64",
+               "--n-informative-features", "16", "--n-classes", "8",
+               "--n-samples", "512", "--quiet"])
     assert rc == 0
