@@ -3,10 +3,12 @@
 #
 # Each script is independent and idempotent; together they rebuild all of
 # docs/perf/*.json, docs/figures/scaling.png, and the numbers quoted in
-# docs/PERF.md. Budget ~45-60 min of chip time end to end (the shared
+# docs/PERF.md. Budget ~2-2.5 h of chip time end to end (the shared
 # tunnel's co-tenant load makes absolute numbers vary 2-3x between runs;
 # every script interleaves its variants so within-artifact comparisons
-# stay meaningful).
+# stay meaningful). NEVER run two of these concurrently: overlapping chip
+# jobs produced physically impossible timings in round 5
+# (docs/ROUND5_NOTES.md, measurement hygiene).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -16,6 +18,9 @@ python examples/bench_breakdown.py         # -> docs/perf/breakdown.json
 python examples/bench_scaling.py           # -> docs/perf/scaling.json + figure
 python examples/bench_presets.py           # -> docs/perf/presets.json
 python examples/bench_faults.py            # -> docs/perf/faults.json
+python examples/bench_sparse_mixing.py     # -> docs/perf/sparse_mixing.json
+python examples/bench_compute_bound.py     # -> docs/perf/compute_bound.json
+python examples/bench_eval_cadence.py      # -> docs/perf/eval_cadence.json
 python examples/reproduce_report.py --json docs/perf/report_reproduction.json
 python examples/northstar_consensus.py --ring-full  # -> docs/perf/northstar_consensus.json
 python bench.py                            # headline JSON line (stdout)
