@@ -135,3 +135,92 @@ def test_dense_sampling_subset_identity(n_workers, n_local, batch, seed, step, d
             np.testing.assert_allclose(dense[i].sum(), 1.0, rtol=1e-5)
         else:
             assert dense_rows.size == 0
+
+
+@settings(**SETTINGS)
+@given(
+    n=st.integers(min_value=3, max_value=24),
+    drop=st.floats(min_value=0.0, max_value=0.95),
+    t=st.integers(min_value=0, max_value=10_000),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_directed_fault_realized_matrix_invariants(n, drop, t, seed):
+    """Round 5: every realized directed-fault matrix is column-stochastic
+    (mass conservation — push-sum's invariant), nonnegative, supported on
+    surviving base edges + diagonal, with drops INDEPENDENT per direction
+    (no symmetrization)."""
+    from distributed_optimization_tpu.parallel.faults import (
+        column_stochastic_weights,
+        sample_surviving_directed_adjacency,
+    )
+
+    topo = build_topology("directed_erdos_renyi", n, erdos_renyi_p=0.5,
+                          seed=seed)
+    key = jax.random.fold_in(jax.random.key(11), t)
+    At = np.asarray(
+        sample_surviving_directed_adjacency(
+            key, jnp.asarray(topo.adjacency, dtype=jnp.float32), drop
+        )
+    )
+    # Survivors only ever come from base edges.
+    assert np.all(At <= topo.adjacency + 1e-12)
+    W = np.asarray(
+        column_stochastic_weights(jnp.asarray(At, dtype=jnp.float32)),
+        dtype=np.float64,
+    )
+    np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-5)
+    assert np.all(W >= -1e-6)
+    assert np.all(W[(topo.adjacency + np.eye(n)) == 0] == 0)
+    # Mass conservation through the operator itself: sum(Wx) == sum(x).
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((n, 2))
+    np.testing.assert_allclose((W @ x).sum(0), x.sum(0), atol=1e-4)
+
+
+@settings(**SETTINGS)
+@given(
+    topology=st.sampled_from(["chain", "star", "erdos_renyi",
+                              "directed_erdos_renyi", "ring"]),
+    n=st.integers(min_value=3, max_value=32),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_sparse_mixing_equals_dense_property(topology, n, seed):
+    """Round 5: the CSR segment-sum contraction is the same linear
+    operator as the dense matmul for arbitrary graphs, both orientations,
+    apply and neighbor_sum."""
+    from distributed_optimization_tpu.ops.mixing import make_mixing_op
+
+    topo = build_topology(topology, n, erdos_renyi_p=0.5, seed=seed)
+    rng = np.random.default_rng(seed % 2**16)
+    x = jnp.asarray(rng.standard_normal((n, 3)), dtype=jnp.float32)
+    dense = make_mixing_op(topo, impl="dense")
+    sparse = make_mixing_op(topo, impl="sparse")
+    np.testing.assert_allclose(np.asarray(sparse.apply(x)),
+                               np.asarray(dense.apply(x)),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sparse.neighbor_sum(x)),
+                               np.asarray(dense.neighbor_sum(x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_directed_drops_are_independent_per_direction():
+    """The directed sampler must NOT symmetrize: on a complete directed
+    graph at drop=0.5, reciprocal pairs (i,j)/(j,i) must differ in some
+    realization (a regression to the undirected symmetric draw would make
+    every realization symmetric)."""
+    from distributed_optimization_tpu.parallel.faults import (
+        sample_surviving_directed_adjacency,
+    )
+
+    n = 8
+    base = jnp.asarray(np.ones((n, n)) - np.eye(n), dtype=jnp.float32)
+    saw_asymmetry = False
+    for t in range(10):
+        key = jax.random.fold_in(jax.random.key(17), t)
+        At = np.asarray(
+            sample_surviving_directed_adjacency(key, base, 0.5)
+        )
+        if not np.array_equal(At, At.T):
+            saw_asymmetry = True
+            break
+    assert saw_asymmetry  # P(all 10 draws symmetric) ~ 2^-280
