@@ -9,11 +9,28 @@ returned by every backend, so the simulator layer is backend-agnostic (the
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 
 import numpy as np
 
 from distributed_optimization_tpu.metrics import RunHistory
+
+
+def x64_scope(config):
+    """Scoped ``jax.enable_x64`` for float64 configs.
+
+    Without it jax silently truncates every array to float32, defeating
+    the fidelity dtype — the single definition of that stance, shared by
+    every jax execution path (jax_backend, tensor_parallel).
+    """
+    import jax
+
+    return (
+        jax.enable_x64()
+        if config.dtype == "float64" and not jax.config.jax_enable_x64
+        else contextlib.nullcontext()
+    )
 
 
 @dataclasses.dataclass

@@ -20,7 +20,6 @@ loops end to end.
 
 from __future__ import annotations
 
-import contextlib
 import time
 from typing import Optional
 
@@ -385,12 +384,9 @@ def run(
     A float64 config runs under a scoped ``enable_x64`` — without it jax
     silently truncates every array to float32, defeating the fidelity dtype.
     """
-    scope = (
-        jax.enable_x64()
-        if config.dtype == "float64" and not jax.config.jax_enable_x64
-        else contextlib.nullcontext()
-    )
-    with scope:
+    from distributed_optimization_tpu.backends.base import x64_scope
+
+    with x64_scope(config):
         return _run(
             config, dataset, f_opt, mesh=mesh, use_mesh=use_mesh,
             batch_schedule=batch_schedule, collect_metrics=collect_metrics,
