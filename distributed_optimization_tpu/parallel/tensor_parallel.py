@@ -89,6 +89,15 @@ def build_tp_softmax_dsgd(
         raise ValueError(f"n_classes {K} must divide over tp={tp}")
     if n < 3:
         raise ValueError("ring gossip needs n_workers >= 3")
+    max_shard = max(len(idx) for idx in dataset.shard_indices)
+    if config.local_batch_size < max_shard:
+        raise ValueError(
+            f"the TP path runs FULL local batches (the compute tier's "
+            f"measured configuration); local_batch_size="
+            f"{config.local_batch_size} < shard size {max_shard} would "
+            "silently train a different trajectory than the DP backend — "
+            "set local_batch_size >= the shard size"
+        )
     eval_every = config.eval_every
     n_evals = T // eval_every
     if collect_metrics and n_evals > EVAL_SEGMENT_LIMIT:
@@ -263,5 +272,9 @@ def run_tp_softmax_dsgd(
     n, K = config.n_workers, config.n_classes
     d = W_final.shape[1]
     W_np = np.asarray(jax.device_get(W_final), dtype=np.float64)
+    if not collect_metrics:
+        # No evals ran: an empty history, not placeholder zeros that would
+        # read as (negative) gaps after the f_opt shift.
+        return W_np.reshape(n, d * K), np.empty(0, dtype=np.float64)
     gaps_np = np.asarray(gaps, dtype=np.float64) - f_opt
     return W_np.reshape(n, d * K), gaps_np

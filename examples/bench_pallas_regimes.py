@@ -18,9 +18,10 @@ MEASURED OUTCOME (the artifact this produced): NO reproducible pallas win
 at any dimension — e2e pallas/stencil ratios bounce 0.78–1.29 with no
 trend across adjacent dims (co-tenant noise), and round 3's single-session
 d=1024 win does not replicate (0.78 here). The round-3 "crossover bracket"
-was noise; there is no crossover to gate on, so
-``jax_backend._resolve_auto_mixing_impl`` never picks pallas and the VMEM
-kernels are explicit opt-in (``mixing_impl='pallas'``).
+was noise; there is no crossover to gate on, so 'auto' (resolved by
+``ops/mixing.py make_mixing_op``; the former jax_backend resolver is
+deleted) never picks pallas and the VMEM kernels are explicit opt-in
+(``mixing_impl='pallas'``).
 Writes ``docs/perf/pallas_regimes.json``; whatever wins is what
 ``mixing_impl='auto'`` must encode.
 

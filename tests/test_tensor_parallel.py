@@ -115,3 +115,17 @@ def test_tp_validation():
                             ds, mesh)
     with pytest.raises(ValueError, match="softmax"):
         run_tp_softmax_dsgd(cfg.replace(problem_type="logistic"), ds, mesh)
+    # Minibatch configs are rejected, not silently run full-batch.
+    with pytest.raises(ValueError, match="FULL local batches"):
+        run_tp_softmax_dsgd(cfg.replace(local_batch_size=4), ds, mesh)
+
+
+def test_tp_metrics_off_returns_empty_history(setup):
+    """collect_metrics=False must not fabricate gap values (placeholder
+    zeros minus f_opt would read as negative gaps)."""
+    cfg, ds, f_opt = setup
+    mesh = make_dp_tp_mesh(2, 4)
+    W_tp, gaps = run_tp_softmax_dsgd(cfg, ds, mesh, f_opt=f_opt,
+                                     collect_metrics=False)
+    assert gaps.shape == (0,)
+    assert np.all(np.isfinite(W_tp))
