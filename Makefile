@@ -1,0 +1,21 @@
+# Test entry points. JAX_PLATFORMS=cpu matches tests/conftest.py's virtual
+# 8-device CPU setup (and keeps a TPU plugin from grabbing the chip).
+
+PY ?= python
+
+.PHONY: test smoke bench-byzantine
+
+# Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
+# local runs should fail loudly on broken collection).
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# Fast robustness smoke: fault-injection + Byzantine suites, first failure
+# stops, strict collection (no marker typos, no swallowed import errors).
+smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest -q -m 'not slow' -x \
+		tests/test_faults.py tests/test_byzantine.py
+
+# Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
+bench-byzantine:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_byzantine.py

@@ -82,6 +82,17 @@ class Algorithm:
     # ``neighbor_sum`` with static degree constants (ADMM's dual update),
     # which a dropped edge would bias.
     supports_edge_faults: bool = True
+    # Whether the step rule tolerates Byzantine injection + robust
+    # neighbor aggregation (docs/BYZANTINE.md). Opt-in: only rules whose
+    # updates go through ``ctx.mix`` alone and whose analyses cover
+    # screened (non-doubly-stochastic) aggregation qualify — D-SGD and
+    # gradient tracking (He-Karimireddy-Jaggi 2022). False for EXTRA
+    # (fixed point needs the static linear W), ADMM (dual updates pair
+    # neighbor sums with static degrees), CHOCO (shared compressed
+    # estimates cannot represent screened-out updates), push-sum (clipping
+    # breaks the column-stochastic mass conservation its debiasing needs),
+    # and the centralized pattern (no peer edges to attack).
+    supports_byzantine: bool = False
     # Optional override of the per-edge float payload for comms accounting:
     # (config, d) -> floats per edge per iteration. None = d · gossip_rounds
     # (full-vector exchange). Compressed-gossip algorithms set this.

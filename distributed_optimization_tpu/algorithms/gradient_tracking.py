@@ -24,6 +24,14 @@ identity whenever every realized W_t is doubly stochastic and a straggler's
 freeze covers all three state leaves — pinned through the real backend
 fault paths in tests/test_faults.py (invariant to ~1e-10 over 400 faulty
 float64 iterations) and measured in docs/perf/faults.json.
+
+Byzantine injection (``supports_byzantine=True``): both gossip rounds go
+through the corrupt/screen composition. Note the caveat in
+docs/BYZANTINE.md — robust (screened) aggregation is not doubly
+stochastic, so the tracking invariant above holds only on the
+plain-gossip attack path; with a robust rule GT composes mechanically but
+the invariant (and with it GT's bias-removal guarantee) is lost, and the
+breakdown benches use D-SGD.
 """
 
 from __future__ import annotations
@@ -52,5 +60,6 @@ def _step(state: State, ctx: StepContext) -> State:
 
 
 GRADIENT_TRACKING = register_algorithm(
-    Algorithm(name="gradient_tracking", init=_init, step=_step, gossip_rounds=2)
+    Algorithm(name="gradient_tracking", init=_init, step=_step,
+              gossip_rounds=2, supports_byzantine=True)
 )

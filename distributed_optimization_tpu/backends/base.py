@@ -18,7 +18,7 @@ from distributed_optimization_tpu.metrics import RunHistory
 
 
 def x64_scope(config):
-    """Scoped ``jax.enable_x64`` for float64 configs.
+    """Scoped ``enable_x64`` for float64 configs.
 
     Without it jax silently truncates every array to float32, defeating
     the fidelity dtype — the single definition of that stance, shared by
@@ -26,8 +26,10 @@ def x64_scope(config):
     """
     import jax
 
+    from distributed_optimization_tpu.parallel._compat import enable_x64
+
     return (
-        jax.enable_x64()
+        enable_x64()
         if config.dtype == "float64" and not jax.config.jax_enable_x64
         else contextlib.nullcontext()
     )

@@ -130,6 +130,14 @@ def run(
         or config.gossip_schedule != "synchronous"
     ):
         raise ValueError("failure injection / one-peer gossip is jax-only")
+    if config.attack != "none" or (
+        config.aggregation != "gossip" and config.robust_b > 0
+    ):
+        raise ValueError(
+            "Byzantine injection / robust aggregation is implemented on "
+            "the jax backend and the numpy oracle (docs/BYZANTINE.md), "
+            "not the native core"
+        )
     if config.algorithm == "choco" and config.compression not in _COMPRESSION_CODES:
         raise ValueError(
             "the cpp CHOCO tier supports the deterministic compressors "

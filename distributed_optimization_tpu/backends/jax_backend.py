@@ -41,6 +41,14 @@ from distributed_optimization_tpu.ops.sampling import (
     sample_worker_batch_weights,
     sample_worker_batches,
 )
+from distributed_optimization_tpu.ops.robust_aggregation import (
+    make_robust_aggregator,
+    validate_budget,
+)
+from distributed_optimization_tpu.parallel.adversary import (
+    make_adversary,
+    make_byzantine_mixing,
+)
 from distributed_optimization_tpu.parallel.faults import (
     make_faulty_mixing,
     make_round_robin_mixing,
@@ -556,17 +564,81 @@ def _run(
                 )
         else:
             faulty = None
+        # --- Byzantine adversary + robust aggregation (docs/BYZANTINE.md).
+        # Active when there is an attack to simulate OR a robust rule with
+        # a positive budget to defend with; robust_b == 0 keeps the plain
+        # gossip path bitwise (a robust rule degrades to MH gossip at zero
+        # budget by definition).
+        byzantine_active = config.attack != "none" or (
+            config.aggregation != "gossip" and config.robust_b > 0
+        )
+        adversary = None
+        byz_mix = None
+        if byzantine_active:
+            if not algo.supports_byzantine:
+                raise ValueError(
+                    f"Byzantine injection / robust aggregation is "
+                    f"unsupported for {algo.name!r}: only step rules whose "
+                    "updates go through the gossip mix alone compose with "
+                    "screened aggregation (EXTRA's fixed point needs the "
+                    "static linear W; ADMM pairs neighbor sums with static "
+                    "degrees; CHOCO's shared estimates cannot represent "
+                    "screened-out updates; push-sum's debiasing needs the "
+                    "column-stochastic mass conservation screening breaks) "
+                    "— use 'dsgd' or 'gradient_tracking'"
+                )
+            if config.mixing_impl == "shard_map":
+                raise ValueError(
+                    "Byzantine injection / robust aggregation requires "
+                    "dense or stencil mixing: the shard_map stencils "
+                    "assume the static uniform-weight benign topology"
+                )
+            adversary = make_adversary(
+                n, config.attack, config.n_byzantine, config.attack_scale,
+                config.seed,
+            )
+            robust_aggregate = None
+            adj_fn = None
+            if config.aggregation != "gossip" and config.robust_b > 0:
+                validate_budget(
+                    int(topo.degrees.min()), config.robust_b,
+                    config.aggregation,
+                )
+                robust_aggregate = make_robust_aggregator(
+                    config.aggregation, config.robust_b, config.clip_tau
+                )
+                if faulty is not None:
+                    adj_fn = faulty.realized_adjacency
+                else:
+                    static_A = jnp.asarray(
+                        topo.adjacency, dtype=jnp.float32
+                    )
+                    adj_fn = lambda t: static_A  # noqa: E731
+            if faulty is not None:
+                base_mix_t = faulty.mix
+            else:
+                base_mix_t = lambda t, v: mix_op.apply(v)  # noqa: E731
+            byz_mix = make_byzantine_mixing(
+                adversary, base_mix_t,
+                aggregate=robust_aggregate, realized_adjacency=adj_fn,
+            )
     else:
         if (
             config.edge_drop_prob > 0.0
             or config.straggler_prob > 0.0
             or config.gossip_schedule != "synchronous"
+            or config.attack != "none"
+            or (config.aggregation != "gossip" and config.robust_b > 0)
         ):
             raise ValueError(
-                "fault injection / matching-based gossip model peer "
-                "exchanges and apply only to decentralized algorithms; the "
-                "centralized pattern has no peer edges"
+                "fault injection / matching-based gossip / Byzantine "
+                "injection model peer exchanges and apply only to "
+                "decentralized algorithms; the centralized pattern has no "
+                "peer edges"
             )
+        byzantine_active = False
+        adversary = None
+        byz_mix = None
         topo = None
         mix_op = None
         faulty = None
@@ -635,11 +707,18 @@ def _run(
     inner_unroll = min(scan_unroll, eval_every)
     outer_unroll = max(1, scan_unroll // eval_every)
 
+    honest_w = None
+    if adversary is not None:
+        honest_w = jnp.asarray(adversary.honest.astype(np.float32))
+
     # The pallas ring kernel fuses the whole canonical gossip-SGD update;
-    # offer it to algorithms via the context (dsgd uses it).
+    # offer it to algorithms via the context (dsgd uses it). Disabled under
+    # Byzantine injection: the fused W x − ηg bypasses the corrupt/screen
+    # composition.
     fused_mix_step = None
     if (
-        faulty is None
+        not byzantine_active
+        and faulty is None
         and mix_op is not None
         and mix_op.impl == "pallas"
         and topo is not None
@@ -711,6 +790,17 @@ def _run(
                 mix_fn, nbr_fn = mix_op.apply, mix_op.neighbor_sum
             else:
                 mix_fn, nbr_fn = (lambda v: v), (lambda v: v * 0)
+            if byz_mix is not None:
+                # Corrupt outgoing models, then (robustly) aggregate — the
+                # composed per-iteration mix from parallel/adversary.py.
+                # neighbor_sum sees the corrupted stack too (consistency;
+                # no byzantine-supported algorithm consumes it today).
+                base_nbr = nbr_fn
+                mix_fn = lambda v: byz_mix(t, v)  # noqa: E731
+                if adversary is not None:
+                    nbr_fn = lambda v: base_nbr(  # noqa: E731
+                        adversary.corrupt(t, v)
+                    )
             ctx = StepContext(
                 grad=grad_fn_factory(t),
                 mix=mix_fn,
@@ -743,12 +833,29 @@ def _run(
             out = {}
             if collect_metrics:
                 x = state["x"]
-                xbar = jnp.mean(x, axis=0)
-                out["gap"] = full_objective(xbar, X, y, n_valid) - f_opt
-                if track_consensus:
-                    out["cons"] = jnp.mean(
-                        jnp.sum((x - xbar[None, :]) ** 2, axis=1)
-                    )
+                if adversary is not None:
+                    # Honest-only metrics (docs/BYZANTINE.md): the gap is
+                    # f(x̄_honest) − f* on the unchanged global objective,
+                    # consensus is the honest spread — Byzantine rows are
+                    # adversary-controlled and would poison both.
+                    hw = honest_w.astype(x.dtype)
+                    nh = jnp.sum(hw)
+                    xbar = jnp.sum(x * hw[:, None], axis=0) / nh
+                    out["gap"] = full_objective(xbar, X, y, n_valid) - f_opt
+                    if track_consensus:
+                        out["cons"] = (
+                            jnp.sum(
+                                hw * jnp.sum((x - xbar[None, :]) ** 2, axis=1)
+                            )
+                            / nh
+                        )
+                else:
+                    xbar = jnp.mean(x, axis=0)
+                    out["gap"] = full_objective(xbar, X, y, n_valid) - f_opt
+                    if track_consensus:
+                        out["cons"] = jnp.mean(
+                            jnp.sum((x - xbar[None, :]) ** 2, axis=1)
+                        )
             return out
 
         def floats_for(ts):
@@ -1022,6 +1129,13 @@ def _run(
         realized_floats if realized_floats is not None else floats_per_iter * T
     )
     final_models = _fetch_to_host(final_state["x"]).astype(np.float64)
+    # The reported model under attack is the HONEST average — Byzantine
+    # rows are adversary-controlled state, not part of the solution.
+    final_avg = (
+        final_models[adversary.honest].mean(axis=0)
+        if adversary is not None
+        else final_models.mean(axis=0)
+    )
 
     history = RunHistory(
         objective=gap_hist,
@@ -1042,7 +1156,7 @@ def _run(
     return BackendRunResult(
         history=history,
         final_models=final_models,
-        final_avg_model=final_models.mean(axis=0),
+        final_avg_model=final_avg,
         final_state=(
             {
                 k: _fetch_to_host(v).astype(np.float64)

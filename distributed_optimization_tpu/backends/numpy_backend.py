@@ -43,9 +43,16 @@ from distributed_optimization_tpu.metrics import (
     centralized_floats_per_iteration,
     consensus_error,
     decentralized_floats_per_iteration,
+    honest_consensus_error,
+    honest_mean,
 )
 from distributed_optimization_tpu.ops import losses_np
+from distributed_optimization_tpu.ops.robust_aggregation import (
+    robust_aggregate_np,
+    validate_budget,
+)
 from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.parallel.adversary import byzantine_mask
 from distributed_optimization_tpu.utils.data import HostDataset
 
 _SUPPORTED = (
@@ -95,6 +102,25 @@ def run(
             "fault-free synchronous semantics"
         )
     algo = get_algorithm(config.algorithm)
+    byz_active = config.attack != "none" or (
+        config.aggregation != "gossip" and config.robust_b > 0
+    )
+    if byz_active:
+        if not algo.supports_byzantine:
+            raise ValueError(
+                f"Byzantine injection / robust aggregation is unsupported "
+                f"for {config.algorithm!r}; use 'dsgd' or "
+                "'gradient_tracking' (see jax_backend for the rationale "
+                "per algorithm)"
+            )
+        if config.attack == "large_noise":
+            raise ValueError(
+                "the numpy oracle supports the deterministic attacks "
+                "(sign_flip, alie); large_noise draws from the jax "
+                "counter-based PRNG inside the step, which an independent "
+                "host implementation cannot reproduce without importing "
+                "the code under test"
+            )
     T = config.n_iterations
     n = config.n_workers
     # Trained parameter dimension: the softmax family's flat [d·K] matrix,
@@ -144,6 +170,50 @@ def run(
         floats_per_iter = centralized_floats_per_iteration(n, d)
         spectral_gap = None
 
+    # --- Byzantine machinery (mirrors jax_backend; docs/BYZANTINE.md).
+    # The Byzantine SET comes from the shared host-side sampler so both
+    # backends agree on who lies; the corruption and the robust rules are
+    # independent numpy twins. Byzantine rows keep the benign W-mix of the
+    # TRUE stack (attackers run honest dynamics internally and lie only on
+    # the wire — same convention as parallel/adversary.py).
+    byz = None
+    if byz_active:
+        byz = byzantine_mask(n, config.n_byzantine, config.seed)
+        robust_name = (
+            config.aggregation
+            if config.aggregation != "gossip" and config.robust_b > 0
+            else None
+        )
+        if robust_name is not None:
+            validate_budget(
+                int(topo.degrees.min()), config.robust_b, config.aggregation
+            )
+        scale = config.attack_scale
+
+        def corrupt_np(v: np.ndarray) -> np.ndarray:
+            if config.attack == "none":
+                return v
+            out = np.array(v, dtype=np.float64, copy=True)
+            if config.attack == "sign_flip":
+                out[byz] = -scale * v[byz]
+            else:  # alie: shared honest_mean − scale·honest_std payload
+                mu = v[~byz].mean(axis=0)
+                sd = v[~byz].std(axis=0)
+                out[byz] = mu - scale * sd
+            return out
+
+        def byz_mix(v: np.ndarray) -> np.ndarray:
+            va = corrupt_np(v)
+            if robust_name is not None:
+                honest_agg = robust_aggregate_np(
+                    robust_name, A, va, config.robust_b, config.clip_tau
+                )
+            else:
+                honest_agg = W @ va
+            if not byz.any():  # pure-defense run: no benign branch needed
+                return honest_agg
+            return np.where(byz[:, None], W @ v, honest_agg)
+
     rng = np.random.default_rng(config.seed)
     eta0 = config.learning_rate_eta0
     sqrt_decay = config.resolved_lr_schedule() == "sqrt_decay"
@@ -175,14 +245,17 @@ def run(
         if config.algorithm == "gradient_tracking":
             # DIGing: x_{t+1} = W x_t − η y_t;  y_{t+1} = W y_t + g_{t+1} − g_t
             # with y_0 = g_prev = 0 (first step is a pure gossip step).
+            # Under Byzantine injection both gossip rounds go through the
+            # corrupt/screen composition, exactly like the jax rule.
+            gossip = byz_mix if byz is not None else (lambda v: W @ v)
             state = {"x": zeros.copy(), "y": zeros.copy(), "g": zeros.copy()}
 
             def matrix_step(state, t, eta, grad_at):
-                x_new = W @ state["x"] - eta * state["y"]
+                x_new = gossip(state["x"]) - eta * state["y"]
                 g_new = grad_at(x_new)
                 return {
                     "x": x_new,
-                    "y": W @ state["y"] + g_new - state["g"],
+                    "y": gossip(state["y"]) + g_new - state["g"],
                     "g": g_new,
                 }
 
@@ -309,7 +382,11 @@ def run(
         else:
             ctx = StepContext(
                 grad=make_grad(t),
-                mix=(lambda v: W @ v) if W is not None else (lambda v: v),
+                mix=(
+                    byz_mix
+                    if byz is not None
+                    else (lambda v: W @ v) if W is not None else (lambda v: v)
+                ),
                 neighbor_sum=(lambda v: A @ v) if A is not None else (lambda v: v * 0),
                 eta=eta,
                 t=t,
@@ -321,12 +398,17 @@ def run(
             k = (t + 1) // eval_every - 1
             x = state["x"]
             if collect_metrics:
-                xbar = x.mean(axis=0)
+                # Honest-only metrics under attack (docs/BYZANTINE.md).
+                xbar = honest_mean(x, byz) if byz is not None else x.mean(axis=0)
                 gap_hist[k] = (
                     objective(xbar, dataset.X_full, dataset.y_full, reg) - f_opt
                 )
                 if track_consensus:
-                    cons_hist[k] = consensus_error(x)
+                    cons_hist[k] = (
+                        honest_consensus_error(x, byz)
+                        if byz is not None
+                        else consensus_error(x)
+                    )
             time_hist[k] = time.perf_counter() - start
 
     run_seconds = time.perf_counter() - start
@@ -343,5 +425,9 @@ def run(
     )
     final = state["x"]
     return BackendRunResult(
-        history=history, final_models=final, final_avg_model=final.mean(axis=0)
+        history=history,
+        final_models=final,
+        final_avg_model=(
+            honest_mean(final, byz) if byz is not None else final.mean(axis=0)
+        ),
     )

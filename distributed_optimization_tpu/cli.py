@@ -27,7 +27,9 @@ import sys
 from typing import Optional, Sequence
 
 from distributed_optimization_tpu.config import (
+    AGGREGATIONS,
     ALGORITHMS,
+    ATTACKS,
     BACKENDS,
     COMPRESSIONS,
     PROBLEM_TYPES,
@@ -182,6 +184,36 @@ def build_parser() -> argparse.ArgumentParser:
                      help="straggler injection: per-iteration probability "
                           "that a node sits the round out (no exchange, no "
                           "local step)")
+    opt.add_argument("--attack", choices=ATTACKS, default=_DEFAULTS.attack,
+                     help="Byzantine injection: n-byzantine workers replace "
+                          "their outgoing models with this payload each "
+                          "gossip round (docs/BYZANTINE.md)")
+    opt.add_argument("--n-byzantine", type=int,
+                     default=_DEFAULTS.n_byzantine,
+                     help="size of the static seed-deterministic Byzantine "
+                          "worker set")
+    opt.add_argument("--attack-scale", type=float,
+                     default=_DEFAULTS.attack_scale,
+                     help="payload magnitude: sign-flip multiplier, "
+                          "large-noise sigma, or ALIE's z (honest std "
+                          "devs of shift)")
+    opt.add_argument("--aggregation", choices=AGGREGATIONS,
+                     default=_DEFAULTS.aggregation,
+                     help="robust neighbor aggregation rule honest workers "
+                          "use in place of plain W@x gossip")
+    opt.add_argument("--robust-b", type=int, default=_DEFAULTS.robust_b,
+                     help="per-neighborhood attack budget for the robust "
+                          "rule (values trimmed per tail / messages "
+                          "clipped); 0 degrades to plain gossip; needs "
+                          "2*b <= min node degree")
+    opt.add_argument("--clip-tau", type=float, default=_DEFAULTS.clip_tau,
+                     help="fixed clipping radius for clipped_gossip "
+                          "(0 = adaptive per-node radius)")
+    opt.add_argument("--partition", choices=("sorted", "shuffled"),
+                     default=_DEFAULTS.partition,
+                     help="worker data split: 'sorted' = the study's "
+                          "non-IID sort-by-target slices; 'shuffled' = "
+                          "IID control (bounded heterogeneity)")
     opt.add_argument("--seed", type=int, default=_DEFAULTS.seed)
     opt.add_argument("--suboptimality-threshold", type=float,
                      default=_DEFAULTS.suboptimality_threshold)
@@ -287,6 +319,13 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         erdos_renyi_p=args.erdos_renyi_p,
         edge_drop_prob=args.edge_drop_prob,
         straggler_prob=args.straggler_prob,
+        attack=args.attack,
+        n_byzantine=args.n_byzantine,
+        attack_scale=args.attack_scale,
+        aggregation=args.aggregation,
+        robust_b=args.robust_b,
+        clip_tau=args.clip_tau,
+        partition=args.partition,
         gossip_schedule=args.gossip_schedule,
         mixing_impl=args.mixing_impl,
         sampling_impl=args.sampling_impl,

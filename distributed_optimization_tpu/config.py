@@ -40,6 +40,22 @@ BACKENDS = ("jax", "numpy", "cpp")
 # which derives from this constant (config stays jax-free).
 COMPRESSIONS = ("none", "top_k", "random_k", "qsgd")
 
+# Byzantine attack models (parallel/adversary.py derives from this constant):
+# a static, seed-deterministic set of `n_byzantine` workers replaces its
+# OUTGOING model each gossip round with an adversarial payload — sign_flip
+# sends −scale·x, large_noise sends x + scale·N(0, I) redrawn per (seed, t),
+# alie sends the colluders' shared "a little is enough" vector
+# honest_mean − scale·honest_std (Baruch et al. 2019), hiding inside the
+# honest spread to evade norm/outlier filters.
+ATTACKS = ("none", "sign_flip", "large_noise", "alie")
+
+# Robust neighbor-aggregation rules (ops/robust_aggregation.py) replacing
+# plain W @ x gossip: coordinate-wise trimmed mean / median over the closed
+# neighborhood, and self-centered clipping (ClippedGossip, He-Karimireddy-
+# Jaggi 2022). 'gossip' is the plain (vulnerable) MH average; a robust rule
+# with robust_b == 0 degrades to exactly plain gossip.
+AGGREGATIONS = ("gossip", "trimmed_mean", "median", "clipped_gossip")
+
 # Default Huber transition point δ: fixed at the synthetic data's noise scale
 # (make_regression noise=10.0, utils/data.py), i.e. the kink sits at ~1σ of the
 # residuals at the optimum — the classical choice. δ is data-scale-dependent,
@@ -104,6 +120,13 @@ class ExperimentConfig:
     # three tiers: jax closures (models/huber.py), numpy twins
     # (losses_np delta kwarg), and the native core (C ABI argument).
     huber_delta: float = DEFAULT_HUBER_DELTA
+    # Data partition across workers: 'sorted' = the study's contiguous
+    # sort-by-target split (maximal non-IID skew, reference utils.py
+    # parity); 'shuffled' = seed-deterministic IID split — the bounded-
+    # heterogeneity control (used by the Byzantine benches: screening
+    # rules provably pay a bias ∝ attack fraction × heterogeneity, so the
+    # breakdown point is only visible without the sorted skew).
+    partition: str = "sorted"
     seed: int = 203  # reference seeds np.random.seed(203) at main.py:24
     eval_every: int = 1  # full-data objective eval cadence (reference: every iter)
     erdos_renyi_p: float = 0.4  # edge probability for the ER topology
@@ -115,6 +138,27 @@ class ExperimentConfig:
     # node sits the round out — it exchanges nothing and takes no local
     # step (its state is frozen for that iteration). 0 = none.
     straggler_prob: float = 0.0
+    # Byzantine adversary injection (docs/BYZANTINE.md): `n_byzantine`
+    # workers (a static seed-deterministic set) replace their OUTGOING
+    # models with an `attack` payload each gossip round. attack_scale is the
+    # payload magnitude: the sign-flip multiplier, the large-noise sigma, or
+    # ALIE's z (how many honest standard deviations the colluders shift).
+    # Composes with edge_drop_prob/straggler_prob (attacks over failing
+    # links) and is decentralized-only, like the fault machinery.
+    attack: str = "none"
+    n_byzantine: int = 0
+    attack_scale: float = 1.0
+    # Robust neighbor aggregation (defense): which rule honest workers use
+    # to combine received neighbor models, and its per-neighborhood attack
+    # budget b (values trimmed from each tail / messages assumed Byzantine).
+    # The backend validates 2·b <= min node degree (otherwise trimming can
+    # exhaust a neighborhood); robust_b == 0 degrades every rule to exactly
+    # plain MH gossip. clip_tau: fixed clipping radius for clipped_gossip
+    # (0 = adaptive: each node clips its b largest-norm neighbor
+    # differences down to the (deg−b)-th smallest norm).
+    aggregation: str = "gossip"
+    robust_b: int = 0
+    clip_tau: float = 0.0
     # Gossip schedule: 'synchronous' averages with all (surviving) neighbors
     # per iteration; 'one_peer' is Boyd-style randomized gossip — each node
     # exchanges with at most ONE mutually-proposing random neighbor, W_t =
@@ -185,6 +229,59 @@ class ExperimentConfig:
         if self.algorithm == "choco" and not 0.0 < self.choco_gamma <= 1.0:
             raise ValueError(
                 f"choco_gamma must be in (0, 1], got {self.choco_gamma}"
+            )
+        if self.partition not in ("sorted", "shuffled"):
+            raise ValueError(f"Unknown partition: {self.partition}")
+        if self.attack not in ATTACKS:
+            raise ValueError(f"Unknown attack: {self.attack}")
+        if self.aggregation not in AGGREGATIONS:
+            raise ValueError(f"Unknown aggregation: {self.aggregation}")
+        if self.n_byzantine < 0:
+            raise ValueError(
+                f"n_byzantine must be >= 0, got {self.n_byzantine}"
+            )
+        if (self.attack == "none") != (self.n_byzantine == 0):
+            raise ValueError(
+                f"attack={self.attack!r} and n_byzantine="
+                f"{self.n_byzantine} must be set together: an attack needs "
+                "attackers, and Byzantine workers need a payload to send"
+            )
+        if self.attack != "none":
+            if self.n_byzantine >= self.n_workers:
+                raise ValueError(
+                    f"n_byzantine ({self.n_byzantine}) must leave at least "
+                    f"one honest worker out of {self.n_workers}"
+                )
+            if self.attack_scale <= 0.0:
+                raise ValueError(
+                    f"attack_scale must be positive, got {self.attack_scale}"
+                )
+        elif self.attack_scale != 1.0:
+            raise ValueError(
+                f"attack_scale={self.attack_scale} only takes effect with "
+                "an attack; attack='none' would silently ignore it"
+            )
+        if self.robust_b < 0:
+            raise ValueError(f"robust_b must be >= 0, got {self.robust_b}")
+        if self.robust_b > 0 and self.aggregation == "gossip":
+            raise ValueError(
+                f"robust_b={self.robust_b} only takes effect with a robust "
+                "aggregation rule; plain 'gossip' has no screening step and "
+                "would silently ignore it"
+            )
+        if self.clip_tau < 0.0:
+            raise ValueError(f"clip_tau must be >= 0, got {self.clip_tau}")
+        if self.clip_tau > 0.0 and self.aggregation != "clipped_gossip":
+            raise ValueError(
+                f"clip_tau only applies to aggregation='clipped_gossip'; "
+                f"{self.aggregation!r} would silently ignore it"
+            )
+        if self.aggregation != "gossip" and self.gossip_schedule != "synchronous":
+            raise ValueError(
+                f"aggregation={self.aggregation!r} screens MULTIPLE received "
+                "neighbor messages per round; matching schedules "
+                f"({self.gossip_schedule!r}) deliver at most one, so no "
+                "trimming/clipping budget is realizable — use 'synchronous'"
             )
         if not 0.0 <= self.edge_drop_prob < 1.0:
             raise ValueError(

@@ -64,6 +64,25 @@ def consensus_error(models: np.ndarray) -> float:
     return float(np.mean(np.sum((models - mean) ** 2, axis=1)))
 
 
+def honest_mean(models: np.ndarray, byzantine: np.ndarray) -> np.ndarray:
+    """Average model over the honest rows only.
+
+    Under Byzantine injection (docs/BYZANTINE.md) the network-wide mean is
+    meaningless — the adversary controls its own rows outright — so every
+    reported metric conditions on the honest set: suboptimality becomes
+    f(x̄_honest) − f(x*) and consensus becomes the honest spread around
+    x̄_honest. ``byzantine`` is the static [N] bool mask from
+    ``parallel.adversary.byzantine_mask`` (all-False reduces both to the
+    standard definitions).
+    """
+    return models[~np.asarray(byzantine, dtype=bool)].mean(axis=0)
+
+
+def honest_consensus_error(models: np.ndarray, byzantine: np.ndarray) -> float:
+    """(1/H) Σ_{honest i} ‖x_i − x̄_honest‖² — Byzantine rows excluded."""
+    return consensus_error(models[~np.asarray(byzantine, dtype=bool)])
+
+
 def iterations_to_threshold(objective_history: np.ndarray, threshold: float,
                             eval_iterations: Optional[np.ndarray] = None) -> int:
     """First (1-based) iteration whose suboptimality gap <= threshold, or -1.

@@ -1,0 +1,220 @@
+"""Robust neighbor aggregation: Byzantine-tolerant replacements for W @ x.
+
+Plain gossip is a linear map — one Byzantine neighbor sending an arbitrary
+vector moves an honest worker's aggregate arbitrarily far (unbounded
+sensitivity). These rules bound that sensitivity by SCREENING the received
+neighbor messages before combining them; all three are jit-compatible pure
+functions of (realized adjacency, stacked models), so they compose with the
+fault machinery's per-iteration graphs (``parallel/faults.py``) inside the
+scanned training loop:
+
+- **coordinate-wise trimmed mean** (Yin et al. 2018, per neighborhood):
+  node i sorts the values of its CLOSED neighborhood {x_j : j ∈ N(i)} ∪
+  {x_i} per coordinate, drops the ``b`` largest and ``b`` smallest, and
+  averages the rest. Tolerates up to b Byzantine neighbors per node: the
+  kept values are bracketed by honest ones in every coordinate.
+- **coordinate-wise median**: the midpoint of the closed-neighborhood
+  values per coordinate — maximal trimming, tolerating any minority of a
+  neighborhood (< (deg+1)/2 attackers).
+- **self-centered clipping** (ClippedGossip, He-Karimireddy-Jaggi 2022):
+  x_i + Σ_j W_ij · clip_τᵢ(x_j − x_i) with W the MH weights recomputed on
+  the realized graph. Each received model moves a worker at most W_ij·τᵢ
+  from its own state regardless of the payload. τᵢ is a fixed config
+  radius, or adaptive: the (degᵢ − b)-th smallest neighbor-difference
+  norm, so exactly the b most-distant messages are clipped down to the
+  honest envelope. τ = ∞ (no clipping) IS plain MH gossip, which is why
+  this rule degrades to the benign path exactly.
+
+Budget semantics: ``b == 0`` means "assume no attackers" — the caller
+(backends) short-circuits to plain MH gossip, bitwise identical to a run
+with ``aggregation='gossip'``. ``validate_budget`` enforces 2·b ≤ min
+degree: beyond that a node's trimmed neighborhood can be empty. Under
+edge faults a REALIZED degree may still drop below 2b+1; the rules then
+degrade per-node to the worker keeping its own model for that round (the
+same identity-row convention an isolated node gets in ``FaultyMixing``).
+
+The ``*_np`` twin is an independent per-node loop implementation written
+directly from the rule definitions (numpy-oracle convention, see
+``backends/numpy_backend.py``): equivalence between the vectorized jax
+forms and this oracle is pinned in tests/test_byzantine.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_optimization_tpu.config import AGGREGATIONS
+from distributed_optimization_tpu.parallel.faults import (
+    metropolis_hastings_weights,
+)
+
+RobustAggregator = Callable[[jax.Array, jax.Array], jax.Array]
+
+
+def validate_budget(min_degree: int, budget: int, aggregation: str) -> None:
+    """Reject trimming budgets the topology cannot support.
+
+    Trimmed mean keeps deg+1−2b closed-neighborhood values, so the
+    weakest node needs 2b ≤ min degree for at least one kept value beyond
+    its own; the same bound keeps clipping's adaptive radius (deg−b ≥ 1
+    unclipped reference) and the median's implicit minority assumption
+    meaningful. Faults may still shrink REALIZED degrees below the bound —
+    that degrades per-node to an identity row, not an error.
+    """
+    if aggregation not in AGGREGATIONS:
+        raise ValueError(f"Unknown aggregation: {aggregation}")
+    if 2 * budget > min_degree:
+        raise ValueError(
+            f"robust_b={budget} exceeds what the topology supports: "
+            f"trimming {budget} from each tail needs 2*b <= min degree "
+            f"({min_degree}), or the weakest node's screened neighborhood "
+            "is empty — lower robust_b or use a better-connected topology"
+        )
+
+
+def make_robust_aggregator(
+    name: str, budget: int, clip_tau: float = 0.0
+) -> RobustAggregator:
+    """Build ``aggregate(A_t, x) -> x_new`` for one rule.
+
+    ``A_t``: realized 0/1 adjacency (zero diagonal, convention
+    ``A[i, j] = 1`` iff j's message reaches i this round); ``x``: the
+    [N, d] stack of models AS TRANSMITTED (the adversary's corruption is
+    applied upstream — honest rows carry true models). Internal math runs
+    in at-least-float32 like the fault machinery; only the output is cast
+    back to the input dtype.
+    """
+    if name not in AGGREGATIONS or name == "gossip":
+        raise ValueError(
+            f"no robust aggregator named {name!r}; plain gossip is built by "
+            "ops/mixing.py / parallel/faults.py"
+        )
+    if budget < 1:
+        # b == 0 is the caller's short-circuit to plain gossip (for the
+        # median, b only gates and sizes the validated assumption — the
+        # rule itself is budget-free); reaching the screened path with an
+        # empty budget is a wiring bug.
+        raise ValueError(
+            f"{name} needs a positive attack budget, got {budget}"
+        )
+
+    def _closed_sorted(A, x):
+        """Ascending per-coordinate sort of the closed neighborhood.
+
+        Returns (sorted [N, N, d] with +inf beyond each row's count,
+        counts [N]): row i holds the values {x_j : A[i,j]=1} ∪ {x_i}.
+        """
+        n = A.shape[0]
+        closed = A + jnp.eye(n, dtype=A.dtype)
+        mask = closed > 0
+        vals = jnp.where(mask[:, :, None], x[None, :, :], jnp.inf)
+        return jnp.sort(vals, axis=1), jnp.sum(closed, axis=1)
+
+    if name == "trimmed_mean":
+
+        def aggregate(A, x):
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            xa = x.astype(acc)
+            s, counts = _closed_sorted(A.astype(acc), xa)
+            # Valid entries occupy sorted positions [0, c_i); keep the
+            # slice [b, c_i − b) — the +inf padding is never selected.
+            pos = jnp.arange(A.shape[0], dtype=acc)
+            keep = (pos[None, :] >= budget) & (
+                pos[None, :] < (counts - budget)[:, None]
+            )
+            kept = jnp.maximum(counts - 2 * budget, 0.0)
+            total = jnp.sum(jnp.where(keep[:, :, None], s, 0.0), axis=1)
+            mean = total / jnp.maximum(kept, 1.0)[:, None]
+            # Faulted-down neighborhoods (c_i ≤ 2b): identity row.
+            return jnp.where(
+                (kept >= 1.0)[:, None], mean, xa
+            ).astype(x.dtype)
+
+    elif name == "median":
+
+        def aggregate(A, x):
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            xa = x.astype(acc)
+            s, counts = _closed_sorted(A.astype(acc), xa)
+            c = counts.astype(jnp.int32)
+            lo = jnp.maximum((c - 1) // 2, 0)[:, None, None]
+            hi = jnp.maximum(c // 2, 0)[:, None, None]
+            med = 0.5 * (
+                jnp.take_along_axis(s, lo, axis=1)
+                + jnp.take_along_axis(s, hi, axis=1)
+            )
+            return med[:, 0, :].astype(x.dtype)
+
+    else:  # clipped_gossip
+
+        def aggregate(A, x):
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            Aa = A.astype(acc)
+            xa = x.astype(acc)
+            W = metropolis_hastings_weights(Aa)
+            diffs = xa[None, :, :] - xa[:, None, :]  # [recv i, send j, d]
+            norms = jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
+            if clip_tau > 0.0:
+                tau = jnp.full(A.shape[0], clip_tau, dtype=acc)
+            else:
+                # Adaptive radius: the (deg−b)-th smallest neighbor
+                # distance — the b most-distant messages get clipped into
+                # the envelope of the rest. deg ≤ b ⇒ τ = 0 (identity row).
+                deg = jnp.sum(Aa, axis=1).astype(jnp.int32)
+                masked = jnp.where(Aa > 0, norms, jnp.inf)
+                ranked = jnp.sort(masked, axis=1)
+                k = jnp.clip(deg - budget - 1, 0, A.shape[0] - 1)
+                kth = jnp.take_along_axis(ranked, k[:, None], axis=1)[:, 0]
+                tau = jnp.where(deg - budget >= 1, kth, 0.0)
+            factor = jnp.minimum(
+                1.0, tau[:, None] / jnp.maximum(norms, jnp.finfo(acc).tiny)
+            )
+            # Off-graph entries have W_ij = 0; the diagonal difference is 0.
+            moved = jnp.sum(W[:, :, None] * diffs * factor[:, :, None], axis=1)
+            return (xa + moved).astype(x.dtype)
+
+    return aggregate
+
+
+def robust_aggregate_np(
+    name: str, A: np.ndarray, x: np.ndarray, budget: int, clip_tau: float = 0.0
+) -> np.ndarray:
+    """Independent per-node oracle of the rules above (float64 numpy).
+
+    Written as explicit per-node loops from the definitions, not by
+    transcribing the vectorized jax forms — the numpy-backend convention
+    for everything the equivalence tests pin.
+    """
+    n = x.shape[0]
+    degs = A.sum(axis=1)
+    out = np.empty_like(x, dtype=np.float64)
+    for i in range(n):
+        nbrs = np.nonzero(A[i])[0]
+        if name in ("trimmed_mean", "median"):
+            vals = np.concatenate([x[nbrs], x[i : i + 1]], axis=0)
+            s = np.sort(vals, axis=0)
+            c = vals.shape[0]
+            if name == "median":
+                out[i] = 0.5 * (s[(c - 1) // 2] + s[c // 2])
+            elif c - 2 * budget >= 1:
+                out[i] = s[budget : c - budget].mean(axis=0)
+            else:
+                out[i] = x[i]
+        elif name == "clipped_gossip":
+            diffs = x[nbrs] - x[i]
+            norms = np.linalg.norm(diffs, axis=1)
+            if clip_tau > 0.0:
+                tau = clip_tau
+            else:
+                k = len(nbrs) - budget
+                tau = float(np.sort(norms)[k - 1]) if k >= 1 else 0.0
+            w = 1.0 / (1.0 + np.maximum(degs[i], degs[nbrs]))
+            fac = np.minimum(1.0, tau / np.maximum(norms, np.finfo(np.float64).tiny))
+            out[i] = x[i] + (w[:, None] * diffs * fac[:, None]).sum(axis=0)
+        else:
+            raise ValueError(f"no robust aggregator named {name!r}")
+    return out

@@ -1,0 +1,30 @@
+"""JAX version compatibility shims.
+
+The codebase is written against the modern spellings ``jax.shard_map`` and
+``jax.enable_x64``; on JAX 0.4.x those live under ``jax.experimental`` (and
+``shard_map`` takes ``check_rep`` where the modern API takes ``check_vma``).
+This module is the single resolution point — every module and test that
+needs either symbol imports it from here instead of probing ``jax``
+directly, so a future JAX upgrade deletes this file and nothing else.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    def shard_map(f, *args, **kwargs):
+        # 0.4.x spells the replication-check kwarg ``check_rep``.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_04(f, *args, **kwargs)
+
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:
+    from jax.experimental import enable_x64  # noqa: F401

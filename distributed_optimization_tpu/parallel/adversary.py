@@ -1,0 +1,187 @@
+"""Byzantine adversary injection: workers that send WRONG models.
+
+The fault layer (``parallel/faults.py``) covers benign failures — links
+and workers that go silent. This module covers the adversarial dimension
+the reference's report only alludes to (its parameter-server single point
+of failure): a static, seed-deterministic set of Byzantine workers that
+participates in every round but replaces its OUTGOING model with an
+attack payload. Three canonical payloads (Blanchard et al. 2017; Baruch
+et al. 2019; He-Karimireddy-Jaggi 2022):
+
+- **sign_flip**: send −scale·x_i — pulls every neighbor away from descent
+  along the attacker's own trajectory;
+- **large_noise**: send x_i + scale·N(0, I), redrawn per (seed, t) — a
+  variance attack that stalls consensus without an obvious direction;
+- **alie** ("a little is enough"): the colluders compute the honest
+  workers' per-coordinate mean and standard deviation (omniscient
+  collusion — the strongest static threat model) and ALL send
+  mean − scale·std, an outlier small enough to hide inside the honest
+  spread and evade norm screens.
+
+Payloads are pure functions of (seed, iteration, transmitted stack) —
+like fault masks and batch sampling there is no carried RNG state, so
+attack realizations are reproducible and checkpoint/resume-safe. Within
+one iteration the corruption is applied per gossip round (gradient
+tracking corrupts both its x and y exchanges); ``large_noise`` reuses the
+(seed, t) draw across same-iteration rounds, which keeps resume exactness
+without per-call counters. All adversarial math runs in at-least-float32
+(the faults convention); only the corrupted stack is cast back to the run
+dtype.
+
+The Byzantine SET is sampled host-side from the config seed
+(``byzantine_mask``) and shared verbatim by the jax backend, the numpy
+oracle backend, and the honest-only metrics — all three must agree on who
+is lying.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_optimization_tpu.config import ATTACKS
+
+# Stream tags folded into the seed key, disjoint from the fault layer's
+# (0x0FA17 edges, 0x57A66 stragglers, 0x3A7C4 matchings).
+_BYZ_SET_TAG = 0xB12A
+_BYZ_NOISE_TAG = 0xBAD0
+
+
+def byzantine_mask(n_workers: int, n_byzantine: int, seed: int) -> np.ndarray:
+    """Static Byzantine node set as a host [N] bool mask.
+
+    Seed-deterministic (a fresh Generator keyed on (seed, tag)), so every
+    layer that needs the honest/Byzantine split — backends, metrics,
+    benches — reconstructs the identical set from the config alone.
+    """
+    if not 0 <= n_byzantine < n_workers:
+        raise ValueError(
+            f"n_byzantine must be in [0, n_workers), got {n_byzantine} "
+            f"of {n_workers}"
+        )
+    mask = np.zeros(n_workers, dtype=bool)
+    if n_byzantine > 0:
+        rng = np.random.default_rng([seed, _BYZ_SET_TAG])
+        mask[rng.choice(n_workers, size=n_byzantine, replace=False)] = True
+    return mask
+
+
+@dataclasses.dataclass(frozen=True)
+class Adversary:
+    """One attack bound to its static Byzantine set.
+
+    ``corrupt(t, x)``: replace Byzantine rows of the [N, d] stack with the
+    iteration-t payload (honest rows pass through untouched — a Byzantine
+    worker lies to its neighbors; it cannot touch anyone else's state).
+    """
+
+    attack: str
+    n_byzantine: int
+    byzantine: np.ndarray  # host [N] bool, static for the whole run
+    corrupt: Callable[[jax.Array, jax.Array], jax.Array]
+
+    @property
+    def honest(self) -> np.ndarray:
+        return ~self.byzantine
+
+
+def make_adversary(
+    n_workers: int,
+    attack: str,
+    n_byzantine: int,
+    attack_scale: float,
+    seed: int,
+) -> Optional[Adversary]:
+    """Build the jit-compatible adversary for a config (None when benign)."""
+    if attack not in ATTACKS:
+        raise ValueError(f"Unknown attack: {attack}")
+    if attack == "none":
+        return None
+    byz = byzantine_mask(n_workers, n_byzantine, seed)
+    byz_dev = jnp.asarray(byz, dtype=jnp.float32)
+    noise_key = jax.random.fold_in(jax.random.key(seed), _BYZ_NOISE_TAG)
+
+    def corrupt(t, x):
+        acc = jnp.promote_types(jnp.float32, x.dtype)
+        xa = x.astype(acc)
+        m = byz_dev.astype(acc).reshape((-1,) + (1,) * (x.ndim - 1))
+        if attack == "sign_flip":
+            payload = -attack_scale * xa
+        elif attack == "large_noise":
+            key = jax.random.fold_in(noise_key, t)
+            payload = xa + attack_scale * jax.random.normal(
+                key, x.shape, dtype=acc
+            )
+        else:  # alie: colluders share honest_mean − scale·honest_std
+            h = (1.0 - byz_dev).astype(acc)
+            n_honest = jnp.sum(h)
+            mu = jnp.sum(xa * h[:, None], axis=0) / n_honest
+            var = (
+                jnp.sum(h[:, None] * (xa - mu[None, :]) ** 2, axis=0)
+                / n_honest
+            )
+            payload = jnp.broadcast_to(
+                mu - attack_scale * jnp.sqrt(var), xa.shape
+            )
+        return jnp.where(m > 0, payload, xa).astype(x.dtype)
+
+    return Adversary(
+        attack=attack, n_byzantine=n_byzantine, byzantine=byz, corrupt=corrupt
+    )
+
+
+def make_byzantine_mixing(
+    adversary: Optional[Adversary],
+    base_mix: Callable[[jax.Array, jax.Array], jax.Array],
+    *,
+    aggregate=None,
+    realized_adjacency=None,
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Compose corruption and (robust) aggregation into one mix(t, x).
+
+    ``base_mix(t, x)``: the benign time-varying gossip (static MixingOp or
+    FaultyMixing) — used when no robust rule is active, i.e. the
+    VULNERABLE baseline the breakdown benches measure. With ``aggregate``
+    (an ``ops.robust_aggregation`` rule) the mix instead screens the
+    corrupted stack over ``realized_adjacency(t)``, so attacks, edge
+    faults, and the defense all see the same per-iteration graph.
+    ``adversary=None`` gives the pure-defense path (robust rule, no
+    attackers).
+
+    Byzantine ROWS keep the benign mix of the TRUE stack: the literature's
+    threat model is an attacker that runs honest dynamics internally (so
+    its transmitted lie — e.g. a flipped model — tracks a plausible
+    trajectory) and lies only on the wire. Feeding attackers their own
+    corrupted echo instead makes their state diverge exponentially under
+    self-centered rules, overflowing to inf and poisoning the honest rows
+    through NaN payloads — a simulation artifact, not an attack.
+    """
+    corrupt = (
+        adversary.corrupt if adversary is not None else (lambda t, x: x)
+    )
+    if aggregate is not None and realized_adjacency is None:
+        raise ValueError(
+            "robust aggregation needs the realized adjacency per "
+            "iteration (static topology or FaultyMixing.realized_adjacency)"
+        )
+
+    def honest_view(t, x):
+        xa = corrupt(t, x)
+        if aggregate is not None:
+            return aggregate(realized_adjacency(t), xa)
+        return base_mix(t, xa)
+
+    if adversary is None:
+        return honest_view
+
+    byz_col = jnp.asarray(adversary.byzantine, dtype=jnp.float32)
+
+    def mix(t, x):
+        m = byz_col.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+        return jnp.where(m > 0, base_mix(t, x), honest_view(t, x))
+
+    return mix

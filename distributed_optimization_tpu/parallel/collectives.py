@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_optimization_tpu.ops.mixing import MixingOp
+from distributed_optimization_tpu.parallel._compat import shard_map
 from distributed_optimization_tpu.parallel.mesh import WORKER_AXIS
 from distributed_optimization_tpu.parallel.topology import Topology
 
@@ -167,12 +168,12 @@ def make_shard_map_mixing_op(topo: Topology, mesh: Mesh) -> MixingOp:
 
             def fn(x):  # x: [N, d] -> grid layout -> stencil -> back
                 g = x.reshape(rows, cols, x.shape[-1])
-                out = jax.shard_map(
+                out = shard_map(
                     block_fn, mesh=mesh, in_specs=spec_in, out_specs=spec_in
                 )(g)
                 return out.reshape(x.shape)
 
             return fn
-        return jax.shard_map(block_fn, mesh=mesh, in_specs=spec_in, out_specs=spec_in)
+        return shard_map(block_fn, mesh=mesh, in_specs=spec_in, out_specs=spec_in)
 
     return MixingOp(topo.name, "shard_map", _wrap(mix_block), _wrap(nbr_block))

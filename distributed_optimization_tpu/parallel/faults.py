@@ -63,7 +63,7 @@ carried RNG state.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -89,6 +89,13 @@ class FaultyMixing:
     active: Callable[[jax.Array], jax.Array]
     drop_prob: float
     straggler_prob: float
+    # ``realized_adjacency(t)``: the surviving [N, N] 0/1 graph at t —
+    # consumed by the Byzantine robust-aggregation layer so attacks and
+    # defenses run over the same per-iteration graph as the mixing. None
+    # for matching schedules (one_peer/round_robin), whose single-partner
+    # exchanges cannot realize a screening budget (config rejects the
+    # combination).
+    realized_adjacency: Optional[Callable[[jax.Array], jax.Array]] = None
 
 
 def sample_surviving_adjacency(key, adjacency: jax.Array, drop_prob: float):
@@ -268,9 +275,11 @@ def make_faulty_mixing(
         key = jax.random.fold_in(match_key, t)
         return sample_one_peer_matching(key, realized_adjacency(t))
 
+    exposed_adjacency = None
     if one_peer:
         mix, neighbor_sum, realized_degree_sum = _matching_ops(partner)
     else:
+        exposed_adjacency = realized_adjacency
         # Accumulate in at-least-float32: bf16 inputs get the f32 upcast the
         # accounting needs, while float64 fidelity runs keep full precision
         # (the 0/1 adjacency is exact in any dtype, so casting it up first
@@ -304,4 +313,5 @@ def make_faulty_mixing(
         active=active,
         drop_prob=drop_prob,
         straggler_prob=straggler_prob,
+        realized_adjacency=exposed_adjacency,
     )

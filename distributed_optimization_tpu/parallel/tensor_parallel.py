@@ -40,6 +40,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_optimization_tpu.backends.base import x64_scope
+from distributed_optimization_tpu.parallel._compat import shard_map
 from distributed_optimization_tpu.parallel.mesh import WORKER_AXIS
 
 MODEL_AXIS = "model"
@@ -232,7 +233,7 @@ def build_tp_softmax_dsgd(
         return Wcur, jnp.stack(outs)
 
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             block_body,
             mesh=mesh,
             in_specs=(

@@ -130,9 +130,16 @@ def generate_synthetic_dataset(config) -> HostDataset:
     X = StandardScaler().fit_transform(X)
     X = np.hstack([X, np.ones((X.shape[0], 1))])  # bias column: d -> d+1
 
-    # Non-IID partition: sort by target, then split contiguously so each
-    # worker sees a narrow slice of the target distribution.
-    order = np.argsort(y)
+    # Default non-IID partition: sort by target, then split contiguously so
+    # each worker sees a narrow slice of the target distribution. The
+    # 'shuffled' alternative is the IID counterfactual (seed-deterministic
+    # random permutation) — the bounded-heterogeneity regime the Byzantine
+    # robust-aggregation analyses assume (docs/BYZANTINE.md), and a control
+    # for separating non-IID effects in any experiment.
+    if config.partition == "shuffled":
+        order = np.random.default_rng(config.seed).permutation(y.shape[0])
+    else:
+        order = np.argsort(y)
     shard_indices = [np.asarray(s) for s in np.array_split(order, config.n_workers)]
 
     return HostDataset(
@@ -146,7 +153,8 @@ def generate_digits_dataset(config) -> HostDataset:
     64 pixel features) instead of synthetic data.
 
     Same preprocessing pipeline as the synthetic path: StandardScaler, bias
-    column, sorted-by-target non-IID partition. For ``logistic`` the labels
+    column, sorted-by-target non-IID partition (or the 'shuffled' IID
+    control, honoring ``config.partition``). For ``logistic`` the labels
     are binarized to ±1 (digit ≥ 5); for ``quadratic`` the digit value is the
     regression target. ``config.n_samples`` caps the sample count;
     ``n_features`` is ignored (the data has 64).
@@ -175,7 +183,10 @@ def generate_digits_dataset(config) -> HostDataset:
     # Constant pixels scale to 0/0; StandardScaler leaves them 0 — fine.
     X = np.hstack([X, np.ones((X.shape[0], 1))])
 
-    order = np.argsort(y, kind="stable")
+    if config.partition == "shuffled":
+        order = np.random.default_rng(config.seed).permutation(y.shape[0])
+    else:
+        order = np.argsort(y, kind="stable")
     shard_indices = [np.asarray(s) for s in np.array_split(order, config.n_workers)]
     return HostDataset(
         X_full=X, y_full=y, shard_indices=shard_indices,

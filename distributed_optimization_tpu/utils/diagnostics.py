@@ -74,8 +74,9 @@ def check_collectives(mesh=None) -> None:
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from distributed_optimization_tpu.parallel._compat import shard_map
 
     from distributed_optimization_tpu.parallel.mesh import WORKER_AXIS, make_worker_mesh
 

@@ -1,0 +1,204 @@
+"""Breakdown-point demonstration for the Byzantine subsystem
+(docs/BYZANTINE.md; acceptance rows for the robust-aggregation rules).
+
+One config — logistic, N=16 fully connected, IID ('shuffled') partition,
+T=4k — swept over the attack/defense matrix:
+
+- ATTACK-FREE: plain gossip, each robust rule at budget b=5 (defense
+  cost), and a zero-budget robust run ASSERTED bitwise-equal to plain
+  (robust_b=0 degrades to the plain path by construction);
+- SIGN-FLIP at the tolerated fraction (f=5 of 16, scale 5): plain gossip
+  must diverge (NaN) or stall ≥10× above the attack-free gap; trimmed
+  mean, median, and clipped gossip must land within 2× of it — both
+  asserted;
+- ALIE and LARGE-NOISE rows at the same fraction (table rows, no hard
+  gate — ALIE is designed to slip through screens, so its damage is
+  bounded but nonzero on BOTH the plain and the screened path);
+- BREAKDOWN SWEEP: trimmed mean at fixed budget b=5 against f ∈
+  {2, 5, 7} attackers — robust up to f ≤ b, visibly broken beyond
+  (f=7 > b leaves attacker values inside every trimmed window).
+
+The IID partition is load-bearing, not cosmetic: screened aggregation
+pays a bias ∝ attack fraction × gradient heterogeneity (He-Karimireddy-
+Jaggi 2022), so under the study's sorted non-IID split the same rules
+stall an order of magnitude above the attack-free gap — the sweep
+records that row too so the limitation is measured, not hidden.
+
+Writes ``docs/perf/byzantine.json``.
+
+Usage:  python examples/bench_byzantine.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/perf/byzantine.json")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.metrics import iterations_to_threshold
+    from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+    from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+    base = ExperimentConfig(
+        problem_type="logistic", algorithm="dsgd", topology="fully_connected",
+        n_workers=16, n_samples=1600, n_features=10,
+        n_informative_features=6, n_iterations=4000, local_batch_size=100,
+        eval_every=500, partition="shuffled",
+    )
+    F, B, S = 5, 5, 5.0  # attackers, budget, sign-flip scale
+
+    def attacked(attack, scale=S, f=F, **kw):
+        return base.replace(
+            attack=attack, n_byzantine=f, attack_scale=scale, **kw
+        )
+
+    variants = {
+        "attack_free": base,
+        "tm_b5_no_attack": base.replace(aggregation="trimmed_mean", robust_b=B),
+        "median_b5_no_attack": base.replace(aggregation="median", robust_b=B),
+        "clip_b5_no_attack": base.replace(
+            aggregation="clipped_gossip", robust_b=B
+        ),
+        "tm_b0_no_attack": base.replace(aggregation="trimmed_mean", robust_b=0),
+        "signflip_plain": attacked("sign_flip"),
+        "signflip_tm": attacked(
+            "sign_flip", aggregation="trimmed_mean", robust_b=B
+        ),
+        "signflip_median": attacked("sign_flip", aggregation="median", robust_b=B),
+        "signflip_clip": attacked(
+            "sign_flip", aggregation="clipped_gossip", robust_b=B
+        ),
+        "alie_plain": attacked("alie", scale=1.0),
+        "alie_tm": attacked(
+            "alie", scale=1.0, aggregation="trimmed_mean", robust_b=B
+        ),
+        "noise_plain": attacked("large_noise", scale=10.0),
+        "noise_tm": attacked(
+            "large_noise", scale=10.0, aggregation="trimmed_mean", robust_b=B
+        ),
+        # Breakdown sweep: fixed budget, growing attacker count.
+        "breakdown_tm_f2": attacked(
+            "sign_flip", f=2, aggregation="trimmed_mean", robust_b=B
+        ),
+        "breakdown_tm_f7": attacked(
+            "sign_flip", f=7, aggregation="trimmed_mean", robust_b=B
+        ),
+        "breakdown_plain_f2": attacked("sign_flip", f=2),
+        # The measured non-IID limitation row (sorted partition).
+        "signflip_tm_sorted": attacked(
+            "sign_flip", aggregation="trimmed_mean", robust_b=B,
+            partition="sorted",
+        ),
+    }
+
+    # One dataset per partition flavor; f_opt from the same oracle path the
+    # simulator uses.
+    data = {}
+    for part in ("shuffled", "sorted"):
+        ds = generate_synthetic_dataset(base.replace(partition=part))
+        _, f_opt = compute_reference_optimum(ds, base.reg_param)
+        data[part] = (ds, f_opt)
+
+    results: dict[str, dict] = {}
+    trajectories: dict[str, list] = {}
+    for name, cfg in variants.items():
+        ds, f_opt = data[cfg.partition]
+        r = jax_backend.run(cfg, ds, f_opt)
+        h = r.history
+        gap = float(h.objective[-1])
+        results[name] = {
+            "final_gap": None if np.isnan(gap) else round(gap, 6),
+            "diverged": bool(np.isnan(gap)),
+            "iterations_to_eps": int(iterations_to_threshold(
+                h.objective, cfg.suboptimality_threshold, h.eval_iterations
+            )),
+            "final_honest_consensus": (
+                None if np.isnan(h.consensus_error[-1])
+                else round(float(h.consensus_error[-1]), 8)
+            ),
+        }
+        trajectories[name] = [
+            None if np.isnan(v) else round(float(v), 6)
+            for v in h.objective
+        ]
+        print(f"[byzantine] {name:22s} gap {results[name]['final_gap']}",
+              file=sys.stderr)
+
+    clean = results["attack_free"]["final_gap"]
+    for name, row in results.items():
+        row["gap_vs_attack_free"] = (
+            None if row["diverged"] or row["final_gap"] is None
+            else round(row["final_gap"] / clean, 3)
+        )
+
+    # --- acceptance gates (the breakdown-point demonstration) ---
+    # Zero-budget robust == plain gossip to accumulation roundoff (the
+    # backend short-circuit makes it bitwise; assert the documented bound).
+    zb = np.asarray(trajectories["tm_b0_no_attack"], dtype=np.float64)
+    pl = np.asarray(trajectories["attack_free"], dtype=np.float64)
+    assert np.max(np.abs(zb - pl)) <= 1e-12, (
+        "zero-budget robust run must match plain gossip trajectories"
+    )
+    # Plain gossip under the in-budget sign-flip: divergent or >= 10x.
+    sp = results["signflip_plain"]
+    assert sp["diverged"] or sp["final_gap"] >= 10.0 * clean, (
+        "plain gossip must diverge or stall >= 10x above attack-free"
+    )
+    # Robust rules under the same attack: within 2x of attack-free.
+    for name in ("signflip_tm", "signflip_median", "signflip_clip"):
+        row = results[name]
+        assert not row["diverged"] and row["final_gap"] <= 2.0 * clean, (
+            f"{name} must converge within 2x of the attack-free run"
+        )
+    # Past the breakdown point (f > b) the defense visibly degrades.
+    assert (
+        results["breakdown_tm_f7"]["diverged"]
+        or results["breakdown_tm_f7"]["final_gap"]
+        > 3.0 * results["breakdown_tm_f2"]["final_gap"]
+    ), "f > b should sit far above the tolerated-fraction rows"
+
+    payload = {
+        "device": str(jax.devices()[0]),
+        "config": (
+            "logistic N=16 fully_connected T=4k shuffled partition; "
+            f"f={F} Byzantine of 16, budget b={B}, sign-flip scale {S}"
+        ),
+        "note": (
+            "final honest-suboptimality gap f(x_bar_honest) - f* per "
+            "variant; gap_vs_attack_free is the breakdown criterion "
+            "(plain diverges under the in-budget sign-flip while trimmed "
+            "mean/median/clipped gossip land within 2x of attack-free; "
+            "trimmed mean at f=7 > b=5 sits past the breakdown point). "
+            "signflip_tm_sorted records the measured non-IID cost: "
+            "screening bias scales with gradient heterogeneity, so the "
+            "sorted partition lands above the IID row (modestly for this "
+            "bounded-gradient logistic tier; the unbounded quadratic tier "
+            "shows the same effect at order-of-magnitude scale)."
+        ),
+        "runs": results,
+        "trajectories": trajectories,
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    print(json.dumps({"metric": "byzantine_variants_measured",
+                      "value": len(results)}))
+
+
+if __name__ == "__main__":
+    main()

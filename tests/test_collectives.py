@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from distributed_optimization_tpu.ops.mixing import make_mixing_op
+from distributed_optimization_tpu.parallel._compat import shard_map
 from distributed_optimization_tpu.parallel.collectives import make_shard_map_mixing_op
 from distributed_optimization_tpu.parallel.mesh import (
     make_worker_mesh,
@@ -80,7 +81,7 @@ def test_ppermute_roundtrip_identity(rng):
         once = jax.lax.ppermute(block, "workers", fwd)
         return jax.lax.ppermute(once, "workers", bwd)
 
-    f = jax.shard_map(
+    f = shard_map(
         roundtrip, mesh=mesh, in_specs=P("workers", None), out_specs=P("workers", None)
     )
     x = shard_over_workers(mesh, jnp.asarray(rng.normal(size=(n, 4)).astype(np.float32)))

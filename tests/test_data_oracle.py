@@ -97,6 +97,31 @@ def test_reference_optimum_is_a_minimum(problem):
     assert np.linalg.norm(g) < 5e-3
 
 
+@pytest.mark.parametrize("generator", ["synthetic", "digits"])
+def test_shuffled_partition_breaks_target_sorting(generator):
+    """partition='shuffled' (the IID control) must be honored by BOTH data
+    paths: same samples, same totals, but shards no longer slice a sorted
+    target range."""
+    if generator == "digits":
+        from distributed_optimization_tpu.utils.data import (
+            generate_digits_dataset as gen,
+        )
+    else:
+        gen = generate_synthetic_dataset
+    kw = dict(problem="logistic", n_workers=5, n_samples=250)
+    srt = gen(small_config(**kw))
+    shf = gen(small_config(partition="shuffled", **kw))
+    np.testing.assert_array_equal(srt.X_full, shf.X_full)
+    # Sorted shards have monotone per-shard target means; shuffled don't.
+    def means(ds):
+        return [ds.shard(i)[1].mean() for i in range(5)]
+    assert means(srt) == sorted(means(srt))
+    assert means(shf) != sorted(means(shf))
+    # Every sample still lands in exactly one shard.
+    all_idx = np.concatenate(shf.shard_indices)
+    assert np.array_equal(np.sort(all_idx), np.arange(250))
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         ExperimentConfig(problem_type="nope")

@@ -15,6 +15,7 @@ import pytest
 from distributed_optimization_tpu.backends import jax_backend, numpy_backend
 from distributed_optimization_tpu.config import ExperimentConfig
 from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.parallel._compat import enable_x64
 from distributed_optimization_tpu.parallel.faults import (
     make_faulty_mixing,
     metropolis_hastings_weights,
@@ -425,7 +426,7 @@ def test_gt_straggler_freeze_covers_all_state_leaves():
     topo = build_topology("ring", cfg.n_workers)
     # Reproduce the backend's mask under the same x64 scope the float64 run
     # used — jax.random.uniform consumes different bits in x64 mode.
-    with jax.enable_x64():
+    with enable_x64():
         fm = make_faulty_mixing(topo, 0.0, seed=cfg.seed, straggler_prob=0.5)
         m = np.asarray(fm.active(jnp.asarray(0)))
     frozen = m == 0.0

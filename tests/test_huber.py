@@ -14,6 +14,7 @@ import pytest
 from conftest import batch_schedule as _schedule, small_backend_config
 from distributed_optimization_tpu.backends import run_algorithm
 from distributed_optimization_tpu.ops import losses, losses_np
+from distributed_optimization_tpu.parallel._compat import enable_x64
 from distributed_optimization_tpu.utils import (
     compute_reference_optimum,
     generate_synthetic_dataset,
@@ -39,7 +40,7 @@ def _rand(shape, seed=0, scale=1.0):
 def _x64():
     """The exactness assertions below compare closed forms at 1e-10..1e-12;
     without x64 jax silently truncates everything to float32."""
-    with jax.enable_x64():
+    with enable_x64():
         yield
 
 

@@ -14,8 +14,15 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO_ROOT, "examples", "multihost_smoke.py")
+
+# jaxlib's CPU backend gained cross-process collectives only after 0.4.x;
+# on runtimes that raise this, the multihost path simply cannot be
+# exercised without real accelerator hardware — skip, don't fail.
+_CPU_UNSUPPORTED = "Multiprocess computations aren't implemented on the CPU"
 
 
 def test_two_process_distributed_run_agrees():
@@ -26,6 +33,13 @@ def test_two_process_distributed_run_agrees():
         timeout=540,
         cwd=REPO_ROOT,
     )
+    if proc.returncode != 0 and _CPU_UNSUPPORTED in (
+        proc.stdout + proc.stderr
+    ):
+        pytest.skip(
+            "this jaxlib's CPU backend has no multiprocess collectives; "
+            "the multihost path needs accelerator hardware here"
+        )
     assert proc.returncode == 0, (
         f"multihost smoke failed\nstdout:\n{proc.stdout[-2000:]}\n"
         f"stderr:\n{proc.stderr[-2000:]}"

@@ -10,7 +10,12 @@ contraction.
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# Optional dep: a missing hypothesis must SKIP this module, not error the
+# whole collection (listed in requirements-test.txt).
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from distributed_optimization_tpu.ops.compression import make_compressor
 from distributed_optimization_tpu.parallel import build_topology

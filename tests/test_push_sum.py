@@ -29,6 +29,7 @@ from distributed_optimization_tpu.backends import run_algorithm
 from distributed_optimization_tpu.backends import jax_backend, numpy_backend
 from distributed_optimization_tpu.config import ExperimentConfig
 from distributed_optimization_tpu.ops.mixing import make_mixing_op
+from distributed_optimization_tpu.parallel._compat import enable_x64
 from distributed_optimization_tpu.parallel.collectives import (
     make_shard_map_mixing_op,
 )
@@ -107,7 +108,7 @@ def test_mass_conservation_all_impls(rng):
     """Σ_i (Ax)_i = Σ_i x_i — the invariant the weight debiasing rests on —
     for the dense matrix AND the directed-ring stencil (float64 scope)."""
     x = rng.standard_normal((16, 7)).astype(np.float64)
-    with jax.enable_x64():
+    with enable_x64():
         for name in ("directed_ring", "directed_erdos_renyi"):
             topo = build_topology(name, 16, erdos_renyi_p=0.3, seed=1)
             op = make_mixing_op(topo, impl="dense", dtype=jnp.float64)
@@ -239,7 +240,7 @@ def test_directed_static_weights_match_topology_builder():
 
     topo = build_topology("directed_erdos_renyi", 10, erdos_renyi_p=0.4,
                           seed=3)
-    with jax.enable_x64():
+    with enable_x64():
         W = np.asarray(
             column_stochastic_weights(
                 jnp.asarray(topo.adjacency, dtype=jnp.float64)

@@ -26,6 +26,7 @@ from conftest import batch_schedule as _schedule, small_backend_config
 from distributed_optimization_tpu.backends import jax_backend, numpy_backend
 from distributed_optimization_tpu.config import ExperimentConfig
 from distributed_optimization_tpu.models import get_problem
+from distributed_optimization_tpu.parallel._compat import enable_x64
 from distributed_optimization_tpu.ops import losses, losses_np
 from distributed_optimization_tpu.utils.data import (
     generate_digits_dataset,
@@ -61,7 +62,7 @@ def test_gradient_matches_autodiff(rng):
     w = rng.normal(size=d * K)
     X = rng.normal(size=(b, d))
     y = rng.integers(0, K, size=b).astype(np.float64)
-    with jax.enable_x64():
+    with enable_x64():
         auto = jax.grad(losses.softmax_objective)(
             jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), lam
         )
@@ -84,7 +85,7 @@ def test_numpy_twin_matches_jax(rng):
     w = rng.normal(size=d * K)
     X = rng.normal(size=(b, d))
     y = rng.integers(0, K, size=b).astype(np.float64)
-    with jax.enable_x64():
+    with enable_x64():
         jo = float(losses.softmax_objective(
             jnp.asarray(w), jnp.asarray(X), jnp.asarray(y), lam))
         jg = np.asarray(losses.softmax_gradient(

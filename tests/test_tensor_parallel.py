@@ -22,6 +22,7 @@ import pytest
 
 from conftest import small_backend_config
 from distributed_optimization_tpu.backends import jax_backend, numpy_backend
+from distributed_optimization_tpu.parallel._compat import enable_x64
 from distributed_optimization_tpu.parallel.tensor_parallel import (
     build_tp_softmax_dsgd,
     make_dp_tp_mesh,
@@ -79,7 +80,7 @@ def test_tp_hlo_communication_pattern(setup):
     cfg, ds, f_opt = setup
     dp, tp = 2, 4
     mesh = make_dp_tp_mesh(dp, tp)
-    with jax.enable_x64():  # f64 config: lower under the dtype it runs at
+    with enable_x64():  # f64 config: lower under the dtype it runs at
         fn, args = build_tp_softmax_dsgd(cfg, ds, mesh,
                                          collect_metrics=False)
         hlo = fn.lower(*args).compile().as_text()
