@@ -3,19 +3,24 @@
 
 PY ?= python
 
-.PHONY: test smoke bench-byzantine
+.PHONY: test smoke bench-byzantine bench-churn
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
-# Fast robustness smoke: fault-injection + Byzantine suites, first failure
-# stops, strict collection (no marker typos, no swallowed import errors).
+# Fast robustness smoke: fault-injection + churn + Byzantine suites, first
+# failure stops, strict collection (no marker typos, no swallowed import
+# errors).
 smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest -q -m 'not slow' -x \
-		tests/test_faults.py tests/test_byzantine.py
+		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py
 
 # Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
 bench-byzantine:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_byzantine.py
+
+# Regenerate the correlated-failure evidence (docs/perf/churn.json).
+bench-churn:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_churn.py

@@ -82,6 +82,21 @@ class Algorithm:
     # ``neighbor_sum`` with static degree constants (ADMM's dual update),
     # which a dropped edge would bias.
     supports_edge_faults: bool = True
+    # Whether the step rule tolerates crash-recovery churn (mttf/mttr:
+    # multi-round outages with frozen state and a rejoin policy —
+    # parallel/faults.py). Opt-in and STRICTER than supports_edge_faults:
+    # beyond per-round doubly stochastic realizations, the rule must stay
+    # meaningful when a node's whole state is frozen for many consecutive
+    # rounds and may be warm-restarted from the neighborhood average on
+    # rejoin. True for D-SGD and gradient tracking (the freeze covers
+    # every leaf and each realized W_t keeps the frozen row at identity,
+    # so GT's tracking invariant mean(y)=mean(g_prev) survives outages of
+    # any length; neighbor_restart touches only the model row). False for
+    # push-sum — a warm restart of z cannot be split consistently across
+    # its (num, w) mass pair, so rejoin policies would silently break the
+    # debiasing — and for EXTRA/ADMM/CHOCO, which already reject
+    # time-varying graphs.
+    supports_churn: bool = False
     # Whether the step rule tolerates Byzantine injection + robust
     # neighbor aggregation (docs/BYZANTINE.md). Opt-in: only rules whose
     # updates go through ``ctx.mix`` alone and whose analyses cover

@@ -61,5 +61,5 @@ def _step(state: State, ctx: StepContext) -> State:
 
 GRADIENT_TRACKING = register_algorithm(
     Algorithm(name="gradient_tracking", init=_init, step=_step,
-              gossip_rounds=2, supports_byzantine=True)
+              gossip_rounds=2, supports_byzantine=True, supports_churn=True)
 )

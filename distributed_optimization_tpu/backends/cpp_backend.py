@@ -127,9 +127,13 @@ def run(
     if (
         config.edge_drop_prob > 0.0
         or config.straggler_prob > 0.0
+        or config.mttf > 0.0
         or config.gossip_schedule != "synchronous"
     ):
-        raise ValueError("failure injection / one-peer gossip is jax-only")
+        raise ValueError(
+            "failure injection / one-peer gossip is implemented on the "
+            "jax backend and the numpy oracle, not the native core"
+        )
     if config.attack != "none" or (
         config.aggregation != "gossip" and config.robust_b > 0
     ):

@@ -536,6 +536,7 @@ def _run(
         time_varying = (
             config.edge_drop_prob > 0.0
             or config.straggler_prob > 0.0
+            or config.mttf > 0.0
             or config.gossip_schedule != "synchronous"
         )
         if time_varying:
@@ -554,6 +555,16 @@ def _run(
                     "undelivered updates; EXTRA's fixed-point argument "
                     "requires a static W)"
                 )
+            if config.mttf > 0.0 and not algo.supports_churn:
+                raise ValueError(
+                    f"crash-recovery churn is unsupported for {algo.name!r}: "
+                    "multi-round outages freeze a node's whole state and "
+                    "may warm-restart its model on rejoin, which only "
+                    "mix-based rules tolerate (push-sum's (num, w) mass "
+                    "pair cannot be restarted consistently; EXTRA/ADMM/"
+                    "CHOCO already reject time-varying graphs) — use "
+                    "'dsgd' or 'gradient_tracking'"
+                )
             if config.gossip_schedule == "round_robin":
                 faulty = make_round_robin_mixing(topo)
             else:
@@ -561,6 +572,9 @@ def _run(
                     topo, config.edge_drop_prob, config.seed,
                     straggler_prob=config.straggler_prob,
                     one_peer=config.gossip_schedule == "one_peer",
+                    burst_len=config.burst_len,
+                    mttf=config.mttf, mttr=config.mttr,
+                    rejoin=config.rejoin, horizon=T,
                 )
         else:
             faulty = None
@@ -626,6 +640,7 @@ def _run(
         if (
             config.edge_drop_prob > 0.0
             or config.straggler_prob > 0.0
+            or config.mttf > 0.0
             or config.gossip_schedule != "synchronous"
             or config.attack != "none"
             or (config.aggregation != "gossip" and config.robust_b > 0)
@@ -783,6 +798,16 @@ def _run(
             return grad
 
         def step(state, t):
+            if faulty is not None and faulty.rejoin_restart is not None:
+                # neighbor_restart rejoin policy: BEFORE the step at the
+                # rejoin round, a node coming back from an outage replaces
+                # its stale model row with the realized-neighborhood
+                # average (auxiliary leaves stay frozen-stale — only the
+                # model is warm-restarted). The restarted value is what it
+                # gossips this round.
+                state = {
+                    **state, "x": faulty.rejoin_restart(t, state["x"])
+                }
             if faulty is not None:
                 mix_fn = lambda v: faulty.mix(t, v)  # noqa: E731
                 nbr_fn = lambda v: faulty.neighbor_sum(t, v)  # noqa: E731
@@ -814,11 +839,15 @@ def _run(
                 fused_mix_step=fused_mix_step,
             )
             new_state = algo.step(state, ctx)
-            if faulty is not None and faulty.straggler_prob > 0.0:
-                # A straggler takes no step at all: freeze its rows across
-                # every state leaf (each leaf leads with the worker axis). Its
-                # mixing row already degenerated to identity via the dropped
-                # edges.
+            if faulty is not None and (
+                faulty.straggler_prob > 0.0 or faulty.churn_active
+            ):
+                # A straggler/crashed node takes no step at all: freeze its
+                # rows across every state leaf (each leaf leads with the
+                # worker axis) — for churn, across the WHOLE outage, so a
+                # 'frozen' rejoin resumes the stale pre-crash state for
+                # free. Its mixing row already degenerated to identity via
+                # the dropped edges.
                 m = faulty.active(t)
                 new_state = jax.tree.map(
                     lambda new, old: jnp.where(

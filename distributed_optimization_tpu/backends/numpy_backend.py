@@ -91,17 +91,45 @@ def run(
             "algorithms plus matrix-form oracles for the exact first-order "
             f"extensions); {config.algorithm!r} is a jax-backend capability"
         )
-    if (
-        config.edge_drop_prob > 0.0
-        or config.straggler_prob > 0.0
-        or config.gossip_schedule != "synchronous"
-    ):
+    if config.gossip_schedule != "synchronous":
         raise ValueError(
-            "failure injection / one-peer gossip is a jax-backend "
-            "capability; the numpy oracle mirrors the reference's "
-            "fault-free synchronous semantics"
+            "matching-based gossip (one_peer/round_robin) is a jax-backend "
+            "capability; the numpy oracle covers the synchronous schedule "
+            "(fault-free or with synchronous failure injection)"
         )
     algo = get_algorithm(config.algorithm)
+    # Synchronous failure injection IS oracle-supported (iid edge drops,
+    # bursty Gilbert-Elliott links, iid stragglers, crash-recovery churn):
+    # the fault SCHEDULE comes from the shared host-side timeline builder —
+    # the same convention as the Byzantine set below, so both backends
+    # agree on which edges/nodes fail — while every piece of mask/weight
+    # MATH (realized MH / column-stochastic weights, the freeze, the
+    # rejoin restart, the realized-floats accounting) is an independent
+    # float64 twin of the jax path.
+    faults_active = (
+        config.edge_drop_prob > 0.0
+        or config.straggler_prob > 0.0
+        or config.mttf > 0.0
+    )
+    if faults_active:
+        if not algo.is_decentralized:
+            raise ValueError(
+                "fault injection models peer exchanges and applies only to "
+                "decentralized algorithms; the centralized pattern has no "
+                "peer edges"
+            )
+        if not algo.supports_edge_faults:
+            raise ValueError(
+                f"time-varying gossip is unsupported for "
+                f"{config.algorithm!r} (see jax_backend for the rationale "
+                "per algorithm)"
+            )
+        if config.mttf > 0.0 and not algo.supports_churn:
+            raise ValueError(
+                f"crash-recovery churn is unsupported for "
+                f"{config.algorithm!r}; use 'dsgd' or 'gradient_tracking' "
+                "(see jax_backend for the rationale per algorithm)"
+            )
     byz_active = config.attack != "none" or (
         config.aggregation != "gossip" and config.robust_b > 0
     )
@@ -170,6 +198,64 @@ def run(
         floats_per_iter = centralized_floats_per_iteration(n, d)
         spectral_gap = None
 
+    # --- failure injection (mirrors jax_backend; docs/CHURN.md). `live`
+    # holds the CURRENT iteration's realized (W_t, A_t); the gossip
+    # closures below read through it so one definition serves the static
+    # and the time-varying case. The weight recomputation rules are
+    # independent numpy twins of parallel/faults.py's jax forms.
+    timeline = None
+    live = {"W": W, "A": A}
+    realized_degree_total = 0.0
+    if faults_active:
+        from distributed_optimization_tpu.parallel.faults import (
+            build_fault_timeline,
+        )
+
+        timeline = build_fault_timeline(
+            topo, T, config.seed,
+            edge_drop_prob=config.edge_drop_prob,
+            burst_len=config.burst_len if config.burst_len >= 1.0 else 1.0,
+            straggler_prob=(
+                0.0 if config.mttf > 0.0 else config.straggler_prob
+            ),
+            mttf=config.mttf, mttr=config.mttr,
+        )
+
+        def _realized_A(t: int) -> np.ndarray:
+            if timeline.edge_up is not None:
+                A_t = np.zeros((n, n))
+                ei = timeline.edge_index[:, 0]
+                ej = timeline.edge_index[:, 1]
+                vals = timeline.edge_up[t].astype(np.float64)
+                A_t[ei, ej] = vals
+                if not topo.directed:
+                    A_t[ej, ei] = vals
+            else:
+                A_t = np.asarray(A, dtype=np.float64).copy()
+            if timeline.node_up is not None:
+                m = timeline.node_up[t].astype(np.float64)
+                A_t *= m[:, None] * m[None, :]  # down node exchanges nothing
+            return A_t
+
+        def _mh_weights(A_t: np.ndarray) -> np.ndarray:
+            # Metropolis-Hastings on realized degrees: symmetric + doubly
+            # stochastic for every draw; an isolated row collapses to I.
+            deg = A_t.sum(axis=1)
+            pair = 1.0 / (1.0 + np.maximum(deg[:, None], deg[None, :]))
+            W_t = A_t * pair
+            return W_t + np.diag(1.0 - W_t.sum(axis=1))
+
+        def _column_stochastic(A_t: np.ndarray) -> np.ndarray:
+            # Surviving-out-link renormalization (directed / push-sum fault
+            # model): columns sum to 1 for every realization.
+            out_deg = A_t.sum(axis=0)
+            W_t = A_t / (1.0 + out_deg)[None, :]
+            return W_t + np.diag(1.0 - W_t.sum(axis=0))
+
+        _realized_weights = (
+            _column_stochastic if topo.directed else _mh_weights
+        )
+
     # --- Byzantine machinery (mirrors jax_backend; docs/BYZANTINE.md).
     # The Byzantine SET comes from the shared host-side sampler so both
     # backends agree on who lies; the corruption and the robust rules are
@@ -203,16 +289,20 @@ def run(
             return out
 
         def byz_mix(v: np.ndarray) -> np.ndarray:
+            # Reads the realized (W_t, A_t) through `live`, so attacks and
+            # screening run over the same per-iteration graph as the
+            # mixing (the realized_adjacency composition of the jax path).
             va = corrupt_np(v)
             if robust_name is not None:
                 honest_agg = robust_aggregate_np(
-                    robust_name, A, va, config.robust_b, config.clip_tau
+                    robust_name, live["A"], va, config.robust_b,
+                    config.clip_tau,
                 )
             else:
-                honest_agg = W @ va
+                honest_agg = live["W"] @ va
             if not byz.any():  # pure-defense run: no benign branch needed
                 return honest_agg
-            return np.where(byz[:, None], W @ v, honest_agg)
+            return np.where(byz[:, None], live["W"] @ v, honest_agg)
 
     rng = np.random.default_rng(config.seed)
     eta0 = config.learning_rate_eta0
@@ -246,8 +336,9 @@ def run(
             # DIGing: x_{t+1} = W x_t − η y_t;  y_{t+1} = W y_t + g_{t+1} − g_t
             # with y_0 = g_prev = 0 (first step is a pure gossip step).
             # Under Byzantine injection both gossip rounds go through the
-            # corrupt/screen composition, exactly like the jax rule.
-            gossip = byz_mix if byz is not None else (lambda v: W @ v)
+            # corrupt/screen composition, exactly like the jax rule; under
+            # faults the realized W_t is read through `live`.
+            gossip = byz_mix if byz is not None else (lambda v: live["W"] @ v)
             state = {"x": zeros.copy(), "y": zeros.copy(), "g": zeros.copy()}
 
             def matrix_step(state, t, eta, grad_at):
@@ -328,8 +419,8 @@ def run(
 
             def matrix_step(state, t, eta, grad_at):
                 g = grad_at(state["x"])
-                num_new = W @ (state["num"] - eta * g)
-                w_new = W @ state["w"]
+                num_new = live["W"] @ (state["num"] - eta * g)
+                w_new = live["W"] @ state["w"]
                 return {"x": num_new / w_new, "num": num_new, "w": w_new}
 
         else:  # choco
@@ -376,6 +467,27 @@ def run(
 
     for t in range(T):
         eta = eta0 / np.sqrt(t + 1.0) if sqrt_decay else eta0
+        if faults_active:
+            A_t = _realized_A(t)
+            live["A"] = A_t
+            live["W"] = _realized_weights(A_t)
+            realized_degree_total += A_t.sum()
+            if (
+                config.rejoin == "neighbor_restart"
+                and timeline.rejoin is not None
+                and timeline.rejoin[t].any()
+            ):
+                # Warm restart BEFORE the step (mirrors jax_backend): a
+                # rejoining node's model row becomes its realized-
+                # neighborhood average; isolated rejoiners stay stale.
+                deg = A_t.sum(axis=1)
+                take = timeline.rejoin[t] & (deg > 0)
+                if take.any():
+                    x_r = state["x"].copy()
+                    nbr = (A_t @ state["x"]) / np.maximum(deg, 1.0)[:, None]
+                    x_r[take] = nbr[take]
+                    state = {**state, "x": x_r}
+        prev_state = state
         if matrix_step is not None:
             grad_fn = make_grad(t)
             state = matrix_step(state, t, eta, lambda p: grad_fn(p, 0))
@@ -385,15 +497,32 @@ def run(
                 mix=(
                     byz_mix
                     if byz is not None
-                    else (lambda v: W @ v) if W is not None else (lambda v: v)
+                    else (lambda v: live["W"] @ v)
+                    if W is not None
+                    else (lambda v: v)
                 ),
-                neighbor_sum=(lambda v: A @ v) if A is not None else (lambda v: v * 0),
+                neighbor_sum=(
+                    (lambda v: live["A"] @ v)
+                    if A is not None
+                    else (lambda v: v * 0)
+                ),
                 eta=eta,
                 t=t,
                 degrees=degrees,
                 config=config,
             )
             state = algo.step(state, ctx)
+        if timeline is not None and timeline.node_up is not None:
+            # A down node takes no step at all: freeze its rows across
+            # every state leaf — for churn, across the WHOLE outage, so a
+            # 'frozen' rejoin resumes the stale pre-crash state for free.
+            up = timeline.node_up[t]
+            state = {
+                k: np.where(
+                    up.reshape((-1,) + (1,) * (v.ndim - 1)), v, prev_state[k]
+                )
+                for k, v in state.items()
+            }
         if (t + 1) % eval_every == 0:
             k = (t + 1) // eval_every - 1
             x = state["x"]
@@ -419,7 +548,14 @@ def run(
         time=time_hist,
         time_measured=True,  # real per-eval perf_counter samples
         eval_iterations=np.arange(eval_every, T + 1, eval_every),
-        total_floats_transmitted=floats_per_iter * T,
+        # Honest comms accounting under faults: floats actually exchanged
+        # over realized edges (same edge payload convention as the jax
+        # backend's realized_degree_sum path).
+        total_floats_transmitted=(
+            realized_degree_total * d * algo.gossip_rounds
+            if faults_active
+            else floats_per_iter * T
+        ),
         iters_per_second=T / run_seconds if run_seconds > 0 else float("inf"),
         spectral_gap=spectral_gap,
     )

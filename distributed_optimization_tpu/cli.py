@@ -33,6 +33,7 @@ from distributed_optimization_tpu.config import (
     BACKENDS,
     COMPRESSIONS,
     PROBLEM_TYPES,
+    REJOINS,
     TOPOLOGIES,
     ExperimentConfig,
 )
@@ -184,6 +185,28 @@ def build_parser() -> argparse.ArgumentParser:
                      help="straggler injection: per-iteration probability "
                           "that a node sits the round out (no exchange, no "
                           "local step)")
+    opt.add_argument("--burst-len", type=float, default=_DEFAULTS.burst_len,
+                     help="bursty link failures (Gilbert-Elliott): mean "
+                          "burst-length multiplier at the SAME marginal "
+                          "--edge-drop-prob (mean burst = "
+                          "burst_len/(1-p) rounds). 0 = memoryless iid "
+                          "drops; 1 reduces bitwise to them; > 1 "
+                          "correlates failures in time (docs/CHURN.md)")
+    opt.add_argument("--mttf", type=float, default=_DEFAULTS.mttf,
+                     help="crash-recovery churn: mean up-time (rounds) "
+                          "before a node crashes; >= 1, set together with "
+                          "--mttr (replaces --straggler-prob; stationary "
+                          "downtime = mttr/(mttf+mttr))")
+    opt.add_argument("--mttr", type=float, default=_DEFAULTS.mttr,
+                     help="crash-recovery churn: mean outage length "
+                          "(rounds) before a crashed node rejoins; >= 1, "
+                          "set together with --mttf")
+    opt.add_argument("--rejoin", choices=REJOINS, default=_DEFAULTS.rejoin,
+                     help="what a node resumes with after an outage: "
+                          "'frozen' = stale pre-crash state (staleness "
+                          "stress test); 'neighbor_restart' = warm restart "
+                          "of the model row from the realized-neighborhood "
+                          "average on the rejoin round")
     opt.add_argument("--attack", choices=ATTACKS, default=_DEFAULTS.attack,
                      help="Byzantine injection: n-byzantine workers replace "
                           "their outgoing models with this payload each "
@@ -319,6 +342,10 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         erdos_renyi_p=args.erdos_renyi_p,
         edge_drop_prob=args.edge_drop_prob,
         straggler_prob=args.straggler_prob,
+        burst_len=args.burst_len,
+        mttf=args.mttf,
+        mttr=args.mttr,
+        rejoin=args.rejoin,
         attack=args.attack,
         n_byzantine=args.n_byzantine,
         attack_scale=args.attack_scale,

@@ -49,6 +49,10 @@ COMPRESSIONS = ("none", "top_k", "random_k", "qsgd")
 # honest spread to evade norm/outlier filters.
 ATTACKS = ("none", "sign_flip", "large_noise", "alie")
 
+# Rejoin policies after a crash-recovery outage (parallel/faults.py
+# REJOIN_POLICIES mirrors this constant; config stays jax-free).
+REJOINS = ("frozen", "neighbor_restart")
+
 # Robust neighbor-aggregation rules (ops/robust_aggregation.py) replacing
 # plain W @ x gossip: coordinate-wise trimmed mean / median over the closed
 # neighborhood, and self-centered clipping (ClippedGossip, He-Karimireddy-
@@ -138,6 +142,28 @@ class ExperimentConfig:
     # node sits the round out — it exchanges nothing and takes no local
     # step (its state is frozen for that iteration). 0 = none.
     straggler_prob: float = 0.0
+    # --- temporally-correlated fault processes (docs/CHURN.md) ---
+    # Bursty link failures: per-edge two-state Markov chain (Gilbert-
+    # Elliott) at the SAME marginal drop rate edge_drop_prob but with mean
+    # burst length burst_len/(1 - edge_drop_prob) — burst_len times the iid
+    # chain's. 0 = the memoryless iid sampler (default); 1 reduces BITWISE
+    # to it (different code path, identical draws/thresholds); > 1
+    # correlates failures in time. Requires edge_drop_prob > 0.
+    burst_len: float = 0.0
+    # Crash-recovery node churn replacing iid stragglers: geometric up/down
+    # holding times with mean up-time `mttf` rounds and mean outage `mttr`
+    # rounds (stationary downtime mttr/(mttf+mttr)); a down node exchanges
+    # nothing and takes no local step for the WHOLE outage. Both 0 = off;
+    # both must be >= 1 and set together, and exclude straggler_prob
+    # (mttf=1/q, mttr=1/(1-q) reduces bitwise to straggler_prob=q).
+    mttf: float = 0.0
+    mttr: float = 0.0
+    # What a node resumes with after an outage: 'frozen' = its stale
+    # pre-crash state (the staleness stress test); 'neighbor_restart' =
+    # warm restart of its model row from the realized-neighborhood average
+    # on the rejoin round (trades exact average preservation for a
+    # consensus reset after long outages). Only meaningful with churn.
+    rejoin: str = "frozen"
     # Byzantine adversary injection (docs/BYZANTINE.md): `n_byzantine`
     # workers (a static seed-deterministic set) replace their OUTGOING
     # models with an `attack` payload each gossip round. attack_scale is the
@@ -290,6 +316,68 @@ class ExperimentConfig:
         if not 0.0 <= self.straggler_prob < 1.0:
             raise ValueError(
                 f"straggler_prob must be in [0, 1), got {self.straggler_prob}"
+            )
+        if self.burst_len != 0.0 and self.burst_len < 1.0:
+            raise ValueError(
+                f"burst_len must be 0 (iid edge drops) or >= 1 (mean burst "
+                f"multiplier), got {self.burst_len}"
+            )
+        if self.burst_len != 0.0 and self.edge_drop_prob == 0.0:
+            raise ValueError(
+                f"burst_len={self.burst_len} shapes the edge-failure "
+                "process and needs edge_drop_prob > 0; without a drop rate "
+                "it would be silently ignored"
+            )
+        if (self.mttf > 0.0) != (self.mttr > 0.0):
+            raise ValueError(
+                f"mttf ({self.mttf}) and mttr ({self.mttr}) must be set "
+                "together: crash-recovery churn needs both a mean up-time "
+                "and a mean outage length"
+            )
+        if self.mttf < 0.0 or self.mttr < 0.0:
+            raise ValueError(
+                f"mttf/mttr must be >= 0, got ({self.mttf}, {self.mttr})"
+            )
+        if self.mttf > 0.0:
+            if self.mttf < 1.0 or self.mttr < 1.0:
+                raise ValueError(
+                    "mttf/mttr are mean holding times in rounds and must "
+                    f"be >= 1, got ({self.mttf}, {self.mttr})"
+                )
+            if self.straggler_prob > 0.0:
+                raise ValueError(
+                    "crash-recovery churn (mttf/mttr) replaces iid "
+                    "stragglers; set straggler_prob=0 (the iid model is "
+                    "churn at mttf=1/q, mttr=1/(1-q))"
+                )
+            if self.gossip_schedule != "synchronous":
+                raise ValueError(
+                    "crash-recovery churn requires "
+                    "gossip_schedule='synchronous': rejoin policies act on "
+                    "the realized neighborhood, which matching schedules "
+                    f"({self.gossip_schedule!r}, at most one partner per "
+                    "round) cannot supply"
+                )
+        if self.rejoin not in REJOINS:
+            raise ValueError(f"Unknown rejoin policy: {self.rejoin}")
+        if self.rejoin == "neighbor_restart" and (
+            self.attack != "none"
+            or (self.aggregation != "gossip" and self.robust_b > 0)
+        ):
+            raise ValueError(
+                "rejoin='neighbor_restart' does not compose with Byzantine "
+                "injection / robust aggregation: the warm restart averages "
+                "neighbors' raw model rows, bypassing both the attack "
+                "payloads and the screening rule — it would model an "
+                "unrealistically safe rejoin at exactly the moment an "
+                "adversary controls the unscreened average. Use "
+                "rejoin='frozen' under attack"
+            )
+        if self.rejoin != "frozen" and self.mttf == 0.0:
+            raise ValueError(
+                f"rejoin={self.rejoin!r} only takes effect with "
+                "crash-recovery churn (mttf/mttr); without outages there "
+                "are no rejoin rounds and it would be silently ignored"
             )
         if self.gossip_schedule not in ("synchronous", "one_peer",
                                         "round_robin"):

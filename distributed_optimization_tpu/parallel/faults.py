@@ -13,6 +13,54 @@ jit-compatible simulations:
   nothing (all incident edges drop) and, in the backend, its state is frozen
   for the iteration (no local gradient step either).
 
+Both of those are MEMORYLESS per-iteration coin flips. Real decentralized
+systems fail in *bursts*: links flap for stretches and nodes crash, stay
+down for many rounds, then rejoin with stale state. Two PERSISTENT
+(temporally-correlated) fault processes share the same interface:
+
+- **bursty link failures** (``burst_len >= 1``): each edge follows an
+  independent two-state Markov chain (Gilbert '60 / Elliott '63 channel
+  model) parameterized by the MARGINAL drop rate ``drop_prob`` plus the
+  burst-length multiplier ``burst_len``.  The transition thresholds are
+  P(down_t | up_{t-1}) = p/B and P(down_t | down_{t-1}) = 1 − (1−p)/B, so
+  the stationary drop rate is exactly p for EVERY B (matched-marginal by
+  construction) while the mean burst length is B/(1−p) — B times the iid
+  chain's.  B = 1 makes both thresholds p, i.e. next-state independent of
+  current state: the chain consumes the SAME uniform draws as the iid
+  sampler and compares them against the SAME threshold, so ``burst_len=1``
+  reduces *bitwise* to today's iid edge drops.
+- **crash–recovery churn** (``mttf``/``mttr``): each node follows a
+  two-state Markov chain with geometric holding times — mean up-time
+  ``mttf`` rounds (P(crash) = 1/mttf) and mean outage ``mttr`` rounds
+  (P(stay down) = 1 − 1/mttr); stationary downtime = mttr/(mttf+mttr).
+  Down nodes exchange nothing and take no local step (the straggler freeze,
+  now spanning whole outages).  The iid straggler model is the point
+  mttf = 1/q, mttr = 1/(1−q) — both thresholds collapse to q, consuming
+  the same draws as the iid straggler sampler, so those values reduce
+  *bitwise* to ``straggler_prob=q``.  The ``rejoin`` policy decides what a
+  node resumes with after an outage: ``'frozen'`` keeps its stale
+  pre-crash state (the staleness stress test — this is what plain freeze
+  gives for free), ``'neighbor_restart'`` warm-restarts its model row from
+  the realized-neighborhood average on the rejoin round (trading exact
+  average preservation for a consensus reset after long outages).
+
+Persistent processes are realized as PRECOMPUTED ``[horizon]``-indexed
+fault timelines (``build_fault_timeline``): the per-(iteration, edge/node)
+uniform draws still come purely from (seed, t) via counter-based keys —
+identical to the on-the-fly samplers — but the chain state is unrolled once
+at setup into host arrays, so per-iteration access is a jit-gatherable
+``timeline[t]`` with NO carried RNG or chain state.  Checkpoint/resume
+therefore stays exact (a resumed run rebuilds the identical timeline from
+the config), and the numpy oracle backend consumes the SAME timeline while
+implementing all mask/weight math independently.  Why correlated faults
+matter at the same marginal rate: the time-varying-gossip analyses this
+repo leans on (Koloskova et al. '20 undirected; Nedić–Olshevsky '16
+directed) bound convergence by WINDOWED connectivity (every B-window's
+union graph connected), not by the marginal drop rate — bursts stretch the
+effective window B̂ (see ``windowed_connectivity``), so convergence
+degrades with burst length even though the average number of dropped edges
+is identical.  ``examples/bench_churn.py`` measures exactly that.
+
 A third *scheduling* mode shares the machinery:
 
 - **one-peer randomized gossip** (``one_peer=True``): instead of averaging
@@ -57,7 +105,10 @@ dtype.
 
 Masks are derived purely from (fault key, iteration) — like batch sampling,
 fault realizations are reproducible and checkpoint/resume-safe with no
-carried RNG state.
+carried RNG state.  The underlying uniform draws are EXPLICIT float32
+(independent of the run dtype and of x64 mode), so the same (seed, t)
+yields the same fault realization on every backend and in every precision —
+the property the timeline precompute and the numpy-oracle parity rely on.
 """
 
 from __future__ import annotations
@@ -67,8 +118,15 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_optimization_tpu.parallel.topology import Topology
+
+# Allowed rejoin policies after a crash-recovery outage (config and CLI
+# derive from this constant): 'frozen' resumes the stale pre-crash state,
+# 'neighbor_restart' warm-restarts the model row from the realized-
+# neighborhood average on the rejoin round.
+REJOIN_POLICIES = ("frozen", "neighbor_restart")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -96,12 +154,55 @@ class FaultyMixing:
     # exchanges cannot realize a screening budget (config rejects the
     # combination).
     realized_adjacency: Optional[Callable[[jax.Array], jax.Array]] = None
+    # --- persistent fault processes (None/0/False when memoryless) ---
+    # Crash-recovery churn is active (the backend must freeze DOWN nodes'
+    # state, exactly like stragglers, for the whole outage).
+    churn_active: bool = False
+    # Rejoin policy in force ('frozen' needs no machinery beyond the
+    # freeze; 'neighbor_restart' supplies ``rejoin_restart``).
+    rejoin: str = "frozen"
+    # ``rejoin_restart(t, x)``: on rejoin rounds, replace a rejoining
+    # node's model row with its realized-neighborhood average (rows of
+    # nodes that are not rejoining — or have no realized neighbors — pass
+    # through untouched). None unless rejoin == 'neighbor_restart'.
+    rejoin_restart: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None
+    # The host-side precomputed timeline backing this mixing (None on the
+    # memoryless on-the-fly path) — exposed for diagnostics
+    # (``node_downtime``, ``windowed_connectivity``) and tests.
+    timeline: Optional["FaultTimeline"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultTimeline:
+    """Precomputed ``[horizon]``-indexed fault realizations (host arrays).
+
+    Pure function of (topology, horizon, seed, fault params): the uniform
+    draw at (t, edge/node) is the same counter-based float32 draw the
+    on-the-fly samplers consume, with the Markov chain state unrolled once
+    at build time — so lookups are jit-gatherable and resume-exact with no
+    carried RNG.  ``edge_up[t, e]`` indexes the base topology's edge list
+    ``edge_index`` ([E, 2]; i<j rows for undirected graphs, ordered (i, j)
+    receiver/sender pairs for directed ones).  ``node_up[t, i]`` is node
+    availability; ``rejoin[t, i]`` marks the first up-round after an
+    outage.  Entries are None for fault modes that are not active.
+    """
+
+    horizon: int
+    directed: bool
+    edge_index: Optional[np.ndarray] = None  # [E, 2] int32
+    edge_up: Optional[np.ndarray] = None     # [horizon, E] bool
+    node_up: Optional[np.ndarray] = None     # [horizon, N] bool
+    rejoin: Optional[np.ndarray] = None      # [horizon, N] bool
 
 
 def sample_surviving_adjacency(key, adjacency: jax.Array, drop_prob: float):
-    """Symmetric iid edge-drop mask applied to a 0/1 adjacency matrix."""
+    """Symmetric iid edge-drop mask applied to a 0/1 adjacency matrix.
+
+    Draws are explicit float32 regardless of x64 mode, so the realization
+    is a function of (key, shape) alone — the timeline precompute and the
+    numpy oracle reproduce it bit-for-bit under any run dtype."""
     n = adjacency.shape[0]
-    u = jax.random.uniform(key, (n, n))
+    u = jax.random.uniform(key, (n, n), dtype=jnp.float32)
     u = jnp.triu(u, 1)
     u = u + u.T  # symmetric: both endpoints see the same draw
     return jnp.where(u >= drop_prob, adjacency, jnp.zeros_like(adjacency))
@@ -115,7 +216,7 @@ def sample_surviving_directed_adjacency(
     Unlike the undirected sampler, the j→i and i→j links (when both exist)
     fail independently — one-way links are exactly what the directed fault
     setting models (Nedić-Olshevsky 2016 time-varying directed graphs)."""
-    u = jax.random.uniform(key, adjacency.shape)
+    u = jax.random.uniform(key, adjacency.shape, dtype=jnp.float32)
     return jnp.where(u >= drop_prob, adjacency, jnp.zeros_like(adjacency))
 
 
@@ -211,12 +312,275 @@ def sample_one_peer_matching(key, adjacency: jax.Array) -> jax.Array:
     activates iff both endpoints proposed each other."""
     n = adjacency.shape[0]
     idx = jnp.arange(n)
-    scores = jax.random.uniform(key, adjacency.shape) * adjacency
+    scores = (
+        jax.random.uniform(key, adjacency.shape, dtype=jnp.float32)
+        * adjacency
+    )
     prop = jnp.argmax(scores, axis=1)
     # Isolated rows (all-zero scores) would spuriously propose node 0.
     prop = jnp.where(jnp.sum(adjacency, axis=1) > 0, prop, idx)
     mutual = prop[prop] == idx
     return jnp.where(mutual, prop, idx)
+
+
+def iid_equivalent_churn(straggler_prob: float) -> tuple[float, float]:
+    """The (mttf, mttr) point at which crash-recovery churn reduces bitwise
+    to iid stragglers at rate q: both chain thresholds collapse to q when
+    mttf = 1/q and mttr = 1/(1−q) (stationary downtime exactly q)."""
+    if not 0.0 < straggler_prob < 1.0:
+        raise ValueError(
+            f"straggler_prob must be in (0, 1), got {straggler_prob}"
+        )
+    return 1.0 / straggler_prob, 1.0 / (1.0 - straggler_prob)
+
+
+def _edge_list(topo: Topology) -> np.ndarray:
+    """[E, 2] int32 edge list of the base topology: one row per undirected
+    edge (i < j — the triu entry whose draw both endpoints share in the iid
+    sampler), or per one-way link (i, j) for directed graphs."""
+    A = np.asarray(topo.adjacency)
+    src = np.triu(A, 1) if not topo.directed else A
+    ei, ej = np.nonzero(src)
+    return np.stack([ei, ej], axis=1).astype(np.int32)
+
+
+def build_fault_timeline(
+    topo: Topology,
+    horizon: int,
+    seed: int,
+    *,
+    edge_drop_prob: float = 0.0,
+    burst_len: float = 1.0,
+    straggler_prob: float = 0.0,
+    mttf: float = 0.0,
+    mttr: float = 0.0,
+) -> FaultTimeline:
+    """Unroll the per-edge / per-node fault chains into host arrays.
+
+    The uniform draw at iteration t is the SAME counter-based float32 draw
+    the on-the-fly samplers consume (same key derivation, same shape), so
+    chains whose thresholds are state-independent — burst_len == 1, or
+    churn at the ``iid_equivalent_churn`` point, or plain ``straggler_prob``
+    — reproduce the iid samplers bit for bit.  Survival convention matches
+    the samplers: alive iff u >= threshold, where
+
+        edge thresholds:  P(down | up) = p/B,  P(down | down) = 1 − (1−p)/B
+        node thresholds:  P(down | up) = 1/mttf, P(down | down) = 1 − 1/mttr
+        (or both = q for iid stragglers)
+
+    with the t = 0 state drawn from the stationary marginal (p, resp.
+    mttr/(mttf+mttr)) so every burst level is matched-marginal from the
+    first iteration.  Memory: one byte per (iteration, edge) plus one per
+    (iteration, node) — [horizon, E] + [horizon, N] bool.
+    """
+    if horizon <= 0:
+        raise ValueError(f"timeline horizon must be positive, got {horizon}")
+    if burst_len < 1.0:
+        raise ValueError(f"burst_len must be >= 1, got {burst_len}")
+    if (mttf > 0.0) != (mttr > 0.0):
+        raise ValueError("mttf and mttr must be set together")
+    if mttf > 0.0 and (mttf < 1.0 or mttr < 1.0):
+        raise ValueError(
+            f"mttf/mttr are mean holding times in rounds and must be >= 1 "
+            f"(got mttf={mttf}, mttr={mttr})"
+        )
+    if mttf > 0.0 and straggler_prob > 0.0:
+        raise ValueError(
+            "crash-recovery churn replaces iid stragglers; set one of "
+            "(mttf, mttr) / straggler_prob, not both"
+        )
+    n = topo.adjacency.shape[0]
+    fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
+    node_key = jax.random.fold_in(jax.random.key(seed), 0x57A66)
+    ts = jnp.arange(horizon, dtype=jnp.int32)
+
+    edge_index = None
+    edge_up = None
+    if edge_drop_prob > 0.0:
+        edge_index = _edge_list(topo)
+        ei = jnp.asarray(edge_index[:, 0])
+        ej = jnp.asarray(edge_index[:, 1])
+        p = edge_drop_prob
+        if burst_len == 1.0:
+            # State-independent thresholds — EXACTLY the iid comparison
+            # (u >= p), guaranteeing the bitwise reduction regardless of
+            # float rounding in the general-B formulas below.
+            t_enter = t_stay = t_init = np.float32(p)
+        else:
+            t_enter = np.float32(p / burst_len)            # P(down | up)
+            t_stay = np.float32(1.0 - (1.0 - p) / burst_len)  # P(down|down)
+            t_init = np.float32(p)                          # stationary
+
+        def edge_step(up_prev, t):
+            u = jax.random.uniform(
+                jax.random.fold_in(fault_key, t), (n, n), dtype=jnp.float32
+            )[ei, ej]
+            thresh = jnp.where(
+                t == 0, t_init, jnp.where(up_prev, t_enter, t_stay)
+            )
+            up = u >= thresh
+            return up, up
+
+        _, ups = jax.lax.scan(
+            edge_step, jnp.ones(edge_index.shape[0], dtype=bool), ts
+        )
+        edge_up = np.asarray(ups)
+
+    node_up = None
+    rejoin = None
+    if mttf > 0.0 or straggler_prob > 0.0:
+        if mttf > 0.0:
+            n_crash = np.float32(1.0 / mttf)           # P(down | up)
+            n_stay = np.float32(1.0 - 1.0 / mttr)      # P(down | down)
+            n_init = np.float32(mttr / (mttf + mttr))  # stationary downtime
+        else:
+            n_crash = n_stay = n_init = np.float32(straggler_prob)
+
+        def node_step(up_prev, t):
+            u = jax.random.uniform(
+                jax.random.fold_in(node_key, t), (n,), dtype=jnp.float32
+            )
+            thresh = jnp.where(
+                t == 0, n_init, jnp.where(up_prev, n_crash, n_stay)
+            )
+            up = u >= thresh
+            return up, up
+
+        _, nups = jax.lax.scan(node_step, jnp.ones(n, dtype=bool), ts)
+        node_up = np.asarray(nups)
+        prev_up = np.concatenate(
+            [np.ones((1, n), dtype=bool), node_up[:-1]], axis=0
+        )
+        rejoin = node_up & ~prev_up
+
+    return FaultTimeline(
+        horizon=horizon,
+        directed=topo.directed,
+        edge_index=edge_index,
+        edge_up=edge_up,
+        node_up=node_up,
+        rejoin=rejoin,
+    )
+
+
+# --- availability / staleness diagnostics (host-side, over a timeline) ----
+
+
+def node_downtime(timeline: FaultTimeline) -> np.ndarray:
+    """Per-node fraction of rounds spent down over the timeline horizon."""
+    if timeline.node_up is None:
+        raise ValueError("timeline has no node fault process")
+    return 1.0 - timeline.node_up.mean(axis=0)
+
+
+def outage_stats(timeline: FaultTimeline) -> dict:
+    """Aggregate outage statistics: count, mean and max outage length (in
+    rounds) across all nodes — the staleness a ``frozen`` rejoin carries."""
+    if timeline.node_up is None:
+        raise ValueError("timeline has no node fault process")
+    lengths: list[int] = []
+    for i in range(timeline.node_up.shape[1]):
+        run = 0
+        for up in timeline.node_up[:, i]:
+            if not up:
+                run += 1
+            elif run:
+                lengths.append(run)
+                run = 0
+        if run:
+            lengths.append(run)  # outage still open at the horizon
+    return {
+        "n_outages": len(lengths),
+        "mean_outage_rounds": float(np.mean(lengths)) if lengths else 0.0,
+        "max_outage_rounds": int(max(lengths)) if lengths else 0,
+    }
+
+
+def _realized_edge_alive(
+    timeline: FaultTimeline, topo: Topology
+) -> tuple[np.ndarray, np.ndarray]:
+    """([T, E] bool alive-mask, [E, 2] edge list) of per-round realized
+    edges: an edge is alive iff its link is up AND both endpoints are up."""
+    edges = (
+        timeline.edge_index
+        if timeline.edge_index is not None
+        else _edge_list(topo)
+    )
+    T = timeline.horizon
+    alive = (
+        timeline.edge_up.copy()
+        if timeline.edge_up is not None
+        else np.ones((T, edges.shape[0]), dtype=bool)
+    )
+    if timeline.node_up is not None:
+        alive &= (
+            timeline.node_up[:, edges[:, 0]]
+            & timeline.node_up[:, edges[:, 1]]
+        )
+    return alive, edges
+
+
+def _union_connected(present: np.ndarray, edges: np.ndarray, n: int) -> bool:
+    """Union-find connectivity of the graph with ``edges[present]`` (weak
+    connectivity for directed edge lists)."""
+    parent = list(range(n))
+
+    def find(a: int) -> int:
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    comps = n
+    for i, j in edges[present]:
+        ri, rj = find(int(i)), find(int(j))
+        if ri != rj:
+            parent[ri] = rj
+            comps -= 1
+    return comps == 1
+
+
+def windowed_connectivity(
+    timeline: FaultTimeline, topo: Topology
+) -> Optional[int]:
+    """B̂: the smallest window length B such that EVERY length-B window's
+    union of realized graphs is connected (Koloskova et al. '20
+    B-connectivity; weak connectivity for directed graphs).
+
+    This is the quantity the time-varying-gossip rates depend on — NOT the
+    marginal drop rate — so at matched marginal, B̂ grows with burst length
+    and with outage duration.  Returns None if even the full horizon's
+    union graph is disconnected (no finite B exists).  Host-side
+    diagnostic: O(T · E · log T) worst case via binary search over B with
+    a prefix-count sliding union per candidate.
+    """
+    alive, edges = _realized_edge_alive(timeline, topo)
+    n = topo.adjacency.shape[0]
+    T = timeline.horizon
+    # Prefix counts: window [s, s+B) contains edge e iff counts differ.
+    csum = np.concatenate(
+        [np.zeros((1, edges.shape[0]), dtype=np.int64),
+         np.cumsum(alive, axis=0, dtype=np.int64)],
+        axis=0,
+    )
+
+    def all_windows_connected(B: int) -> bool:
+        for s in range(T - B + 1):
+            present = (csum[s + B] - csum[s]) > 0
+            if not _union_connected(present, edges, n):
+                return False
+        return True
+
+    if not all_windows_connected(T):
+        return None
+    lo, hi = 1, T  # predicate is monotone in B (bigger window ⊇ union)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if all_windows_connected(mid):
+            hi = mid
+        else:
+            lo = mid + 1
+    return lo
 
 
 def make_faulty_mixing(
@@ -225,12 +589,24 @@ def make_faulty_mixing(
     seed: int,
     straggler_prob: float = 0.0,
     one_peer: bool = False,
+    burst_len: float = 0.0,
+    mttf: float = 0.0,
+    mttr: float = 0.0,
+    rejoin: str = "frozen",
+    horizon: Optional[int] = None,
 ) -> FaultyMixing:
     """Build time-varying mixing operators for a base topology.
 
     All internal fault machinery (masks, realized adjacency, MH weights,
     degree accounting) runs in float32; only ``mix``/``neighbor_sum`` outputs
     are cast back to the input's dtype.
+
+    Memoryless faults (``drop_prob``/``straggler_prob`` alone) sample masks
+    on the fly from (seed, t).  Persistent processes — bursty links
+    (``burst_len >= 1``) and crash-recovery churn (``mttf``/``mttr``) —
+    require ``horizon`` and route through a precomputed
+    ``build_fault_timeline`` (gathered per iteration; bitwise-identical to
+    the on-the-fly path at burst_len=1 / the iid-equivalent churn point).
     """
     if not 0.0 <= drop_prob < 1.0:
         raise ValueError(f"drop_prob must be in [0, 1), got {drop_prob}")
@@ -244,30 +620,118 @@ def make_faulty_mixing(
             f"topology {topo.name!r} has one-way links, so a pairwise "
             "exchange cannot be realized"
         )
+    if burst_len != 0.0 and burst_len < 1.0:
+        raise ValueError(
+            f"burst_len must be 0 (iid sampler) or >= 1, got {burst_len}"
+        )
+    if rejoin not in REJOIN_POLICIES:
+        raise ValueError(
+            f"Unknown rejoin policy: {rejoin!r}; known: {REJOIN_POLICIES}"
+        )
+    churn_active = mttf > 0.0 or mttr > 0.0
+    if churn_active and one_peer:
+        raise ValueError(
+            "crash-recovery churn requires the synchronous schedule: rejoin "
+            "policies act on the realized neighborhood, which a one-peer "
+            "matching (at most one partner per round) cannot supply"
+        )
+    use_timeline = burst_len >= 1.0 or churn_active
+    timeline = None
+    if use_timeline:
+        if horizon is None:
+            raise ValueError(
+                "persistent fault processes (burst_len >= 1 or mttf/mttr) "
+                "precompute a [horizon]-indexed timeline; pass "
+                "horizon=n_iterations"
+            )
+        timeline = build_fault_timeline(
+            topo, horizon, seed,
+            edge_drop_prob=drop_prob,
+            burst_len=burst_len if burst_len >= 1.0 else 1.0,
+            straggler_prob=0.0 if churn_active else straggler_prob,
+            mttf=mttf, mttr=mttr,
+        )
     base_A = jnp.asarray(topo.adjacency, dtype=jnp.float32)
     # Distinct streams from batch sampling: fold tags into the seed key.
     fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
     node_key = jax.random.fold_in(jax.random.key(seed), 0x57A66)
 
-    def active(t) -> jax.Array:
-        if straggler_prob == 0.0:
-            return jnp.ones(base_A.shape[0], dtype=jnp.float32)
-        key = jax.random.fold_in(node_key, t)
-        u = jax.random.uniform(key, (base_A.shape[0],))
-        return (u >= straggler_prob).astype(jnp.float32)
+    if use_timeline:
+        node_up_dev = (
+            jnp.asarray(timeline.node_up)
+            if timeline.node_up is not None else None
+        )
+        edge_up_dev = (
+            jnp.asarray(timeline.edge_up)
+            if timeline.edge_up is not None else None
+        )
+        if edge_up_dev is not None:
+            ei = jnp.asarray(timeline.edge_index[:, 0], dtype=jnp.int32)
+            ej = jnp.asarray(timeline.edge_index[:, 1], dtype=jnp.int32)
 
-    def realized_adjacency(t) -> jax.Array:
-        if drop_prob == 0.0 and straggler_prob == 0.0:
-            return base_A  # no fault sampling on the fault-free fast path
-        key = jax.random.fold_in(fault_key, t)
-        if topo.directed:
-            A_t = sample_surviving_directed_adjacency(key, base_A, drop_prob)
-        else:
-            A_t = sample_surviving_adjacency(key, base_A, drop_prob)
-        if straggler_prob > 0.0:
-            m = active(t)
-            A_t = A_t * m[:, None] * m[None, :]  # straggler exchanges nothing
-        return A_t
+        def active(t) -> jax.Array:
+            if node_up_dev is None:
+                return jnp.ones(base_A.shape[0], dtype=jnp.float32)
+            return node_up_dev[t].astype(jnp.float32)
+
+        def realized_adjacency(t) -> jax.Array:
+            if edge_up_dev is not None:
+                e = edge_up_dev[t].astype(jnp.float32)
+                half = jnp.zeros_like(base_A).at[ei, ej].set(e)
+                A_t = half if topo.directed else half + half.T
+            else:
+                A_t = base_A
+            if node_up_dev is not None:
+                m = active(t)
+                A_t = A_t * m[:, None] * m[None, :]  # down: exchanges nothing
+            return A_t
+    else:
+
+        def active(t) -> jax.Array:
+            if straggler_prob == 0.0:
+                return jnp.ones(base_A.shape[0], dtype=jnp.float32)
+            key = jax.random.fold_in(node_key, t)
+            u = jax.random.uniform(
+                key, (base_A.shape[0],), dtype=jnp.float32
+            )
+            return (u >= straggler_prob).astype(jnp.float32)
+
+        def realized_adjacency(t) -> jax.Array:
+            if drop_prob == 0.0 and straggler_prob == 0.0:
+                return base_A  # no fault sampling on the fault-free fast path
+            key = jax.random.fold_in(fault_key, t)
+            if topo.directed:
+                A_t = sample_surviving_directed_adjacency(
+                    key, base_A, drop_prob
+                )
+            else:
+                A_t = sample_surviving_adjacency(key, base_A, drop_prob)
+            if straggler_prob > 0.0:
+                m = active(t)
+                A_t = A_t * m[:, None] * m[None, :]  # exchanges nothing
+            return A_t
+
+    rejoin_restart = None
+    if churn_active and rejoin == "neighbor_restart":
+        rejoin_dev = jnp.asarray(timeline.rejoin)
+
+        def rejoin_restart(t, x) -> jax.Array:
+            # Warm restart: a rejoining node replaces its (stale) model row
+            # with the average of its REALIZED neighbors' current rows —
+            # exactly the neighborhood it can actually hear from on the
+            # rejoin round.  Isolated rejoiners (no surviving realized
+            # neighbor) keep their stale state.  float32 accumulation floor
+            # like all fault machinery; output cast back to the run dtype.
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            A_t = realized_adjacency(t).astype(acc)
+            deg = jnp.sum(A_t, axis=1)
+            nbr_avg = jnp.tensordot(A_t, x.astype(acc), axes=1) / jnp.maximum(
+                deg, 1.0
+            )[:, None]
+            take = rejoin_dev[t] & (deg > 0)
+            return jnp.where(
+                take[:, None], nbr_avg, x.astype(acc)
+            ).astype(x.dtype)
 
     match_key = jax.random.fold_in(jax.random.key(seed), 0x3A7C4)
 
@@ -314,4 +778,8 @@ def make_faulty_mixing(
         drop_prob=drop_prob,
         straggler_prob=straggler_prob,
         realized_adjacency=exposed_adjacency,
+        churn_active=churn_active,
+        rejoin=rejoin,
+        rejoin_restart=rejoin_restart,
+        timeline=timeline,
     )

@@ -117,11 +117,27 @@ class RunCheckpointer:
         self.validate_or_record_config(config)  # first-write path: records
 
     def completed_chunks(self) -> list[int]:
+        """Chunk numbers with a plausibly-complete checkpoint directory.
+
+        Robust against crash-mid-save debris: orbax staging directories
+        (``<step>.orbax-checkpoint-tmp-<ts>`` and any other non-digit
+        name) and empty chunk directories (a crash between mkdir and the
+        first write) are skipped. A chunk dir that LOOKS complete but was
+        truncated mid-write is caught later by ``restore``'s
+        fall-back-to-previous-intact-chunk path — completeness of the
+        orbax payload can only be established by reading it.
+        """
         out = []
         for name in os.listdir(self.directory):
             path = os.path.join(self.directory, name)
-            if name.isdigit() and os.path.isdir(path) and not name.endswith(".tmp"):
-                out.append(int(name))
+            if not (name.isdigit() and os.path.isdir(path)):
+                continue  # sidecar, orbax tmp/staging dirs, foreign files
+            try:
+                if not os.listdir(path):
+                    continue  # crashed between mkdir and first write
+            except OSError:
+                continue
+            out.append(int(name))
         return sorted(out)
 
     def latest_chunk(self) -> Optional[int]:
@@ -150,12 +166,36 @@ class RunCheckpointer:
 
     def restore(self, chunk: Optional[int] = None):
         """Return (state, gap_hist, cons_hist, floats_hist, time_hist, chunk),
-        or None."""
-        if chunk is None:
-            chunk = self.latest_chunk()
-        if chunk is None:
-            return None
-        payload = self._ckptr.restore(self._step_dir(chunk))
+        or None.
+
+        With ``chunk=None`` (the resume path), a latest chunk directory
+        that fails to restore — a crash mid-save can leave a
+        complete-looking but truncated orbax payload — is skipped with a
+        warning and the previous intact chunk is restored instead (the run
+        just re-executes the lost chunks; resume-exactness is unaffected
+        because all RNG derives from (seed, t)). An EXPLICIT chunk request
+        still raises, so callers asking for a specific checkpoint see the
+        corruption."""
+        if chunk is not None:
+            return self._unpack(self._ckptr.restore(self._step_dir(chunk)))
+        for c in reversed(self.completed_chunks()):
+            try:
+                payload = self._ckptr.restore(self._step_dir(c))
+            except Exception as e:  # orbax raises various types here
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint chunk {c} at {self._step_dir(c)} is "
+                    f"partial or corrupt ({type(e).__name__}: {e}); "
+                    "falling back to the previous intact chunk",
+                    stacklevel=2,
+                )
+                continue
+            return self._unpack(payload)
+        return None
+
+    @staticmethod
+    def _unpack(payload):
         empty = np.empty(0, dtype=np.float64)
         return (
             payload["state"],

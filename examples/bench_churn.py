@@ -1,0 +1,247 @@
+"""Temporally-correlated-failure demonstration (ISSUE 2 headline artifact;
+docs/CHURN.md).
+
+The iid fault model answers "how many edges fail?"; the time-varying-gossip
+rates (Koloskova et al. '20) depend on "how long can the network stay
+effectively partitioned?" — windowed union-graph connectivity, B̂.  This
+bench pins the difference with a matched-marginal burst sweep plus a
+crash-recovery churn study:
+
+- BURST SWEEP: D-SGD, ring N=16, per-edge drop rate FIXED at p=0.3 while
+  the Gilbert-Elliott mean burst length sweeps 1x/4x/16x/48x the iid
+  chain's.  Asserted: (a) ``burst_len=1`` matches the iid-fault baseline
+  trajectory BITWISE (same draws, same thresholds, different code path);
+  (b) consensus error degrades MONOTONELY with burst length at the same
+  marginal drop rate; (c) the measured B̂ diagnostic grows monotonely with
+  burst length — the mechanism behind (b).
+- CHURN + GT INVARIANT: gradient tracking under crash-recovery churn
+  (MTTF/MTTR holding times) composed with bursty links, float64, frozen
+  rejoin.  Asserted: the tracking invariant mean(y) = mean(g_prev) holds
+  to accumulation roundoff through whole outages — staleness does not
+  break the bias correction.
+- REJOIN POLICY: D-SGD under rare-but-long outages (MTTF 400, MTTR 150
+  rounds), ``frozen`` vs ``neighbor_restart`` on the SAME fault timeline.
+  Asserted: the warm restart ends at-or-below the stale-state policy's
+  consensus error after the outages.
+
+Writes ``docs/perf/churn.json`` (trajectories, availability/staleness
+diagnostics, B̂ per burst level, all gate outcomes).
+
+Usage:  python examples/bench_churn.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/perf/churn.json")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.parallel import build_topology
+    from distributed_optimization_tpu.parallel.faults import (
+        build_fault_timeline,
+        node_downtime,
+        outage_stats,
+        windowed_connectivity,
+    )
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+    from distributed_optimization_tpu.utils.oracle import (
+        compute_reference_optimum,
+    )
+
+    base = ExperimentConfig(
+        problem_type="quadratic", algorithm="dsgd", topology="ring",
+        n_workers=16, n_samples=1600, n_features=10,
+        n_informative_features=6, n_iterations=3000, local_batch_size=16,
+        eval_every=100,
+    )
+    P = 0.3  # matched marginal per-edge drop rate for the whole sweep
+    BURSTS = (1.0, 4.0, 16.0, 48.0)
+
+    ds = generate_synthetic_dataset(base)
+    _, f_opt = compute_reference_optimum(ds, base.reg_param)
+    topo = build_topology(base.topology, base.n_workers)
+
+    results: dict[str, dict] = {}
+
+    def record(name, cfg, r):
+        h = r.history
+        results[name] = {
+            "final_gap": round(float(h.objective[-1]), 8),
+            "mean_consensus": round(
+                float(np.mean(h.consensus_error)), 10
+            ),
+            "final_consensus": round(
+                float(h.consensus_error[-1]), 10
+            ),
+            "realized_floats": float(h.total_floats_transmitted),
+            "objective": [round(float(v), 8) for v in h.objective],
+            "consensus": [
+                round(float(v), 10) for v in h.consensus_error
+            ],
+        }
+        print(
+            f"[churn] {name:22s} gap {results[name]['final_gap']:.2e}  "
+            f"mean-cons {results[name]['mean_consensus']:.3e}",
+            file=sys.stderr,
+        )
+        return results[name]
+
+    # --- burst sweep at matched marginal drop rate -----------------------
+    iid = record("iid_p03", base, jax_backend.run(
+        base.replace(edge_drop_prob=P), ds, f_opt
+    ))
+    bhat = {}
+    for B in BURSTS:
+        cfg = base.replace(edge_drop_prob=P, burst_len=B)
+        row = record(f"burst_{B:g}", cfg, jax_backend.run(cfg, ds, f_opt))
+        tl = build_fault_timeline(
+            topo, base.n_iterations, base.seed, edge_drop_prob=P,
+            burst_len=B,
+        )
+        row["marginal_drop_rate"] = round(float(1.0 - tl.edge_up.mean()), 5)
+        row["windowed_connectivity_Bhat"] = windowed_connectivity(tl, topo)
+        bhat[B] = row["windowed_connectivity_Bhat"]
+
+    # Gate 1: burst_len=1 is the iid baseline, bitwise (timeline path vs
+    # the on-the-fly sampler path — same draws, same thresholds).
+    assert results["burst_1"]["objective"] == results["iid_p03"]["objective"]
+    assert results["burst_1"]["consensus"] == results["iid_p03"]["consensus"]
+    assert (
+        results["burst_1"]["realized_floats"]
+        == results["iid_p03"]["realized_floats"]
+    ), "matched marginal must also match realized comms"
+
+    # Gate 2: monotone degradation with burst length at MATCHED marginal —
+    # the iid model's blind spot, measured.
+    cons = [results[f"burst_{B:g}"]["mean_consensus"] for B in BURSTS]
+    assert all(a < b for a, b in zip(cons, cons[1:])), (
+        f"consensus error must degrade monotonely with burst length: {cons}"
+    )
+    gaps = [results[f"burst_{B:g}"]["final_gap"] for B in BURSTS]
+    assert all(a < b for a, b in zip(gaps, gaps[1:])), (
+        f"final gap must degrade monotonely with burst length: {gaps}"
+    )
+    # Marginal drop rate stays matched across the sweep (within sampling
+    # noise), so the degradation is attributable to correlation alone.
+    for B in BURSTS:
+        assert abs(
+            results[f"burst_{B:g}"]["marginal_drop_rate"] - P
+        ) < 0.02, B
+    # The mechanism: windowed connectivity B̂ grows with burstiness.
+    bvals = [bhat[B] for B in BURSTS]
+    assert all(a <= b for a, b in zip(bvals, bvals[1:])) and bvals[0] < bvals[-1], (
+        f"B-hat must grow with burst length: {bvals}"
+    )
+
+    # --- churn: GT tracking invariant through whole outages --------------
+    gt_cfg = base.replace(
+        algorithm="gradient_tracking", lr_schedule="constant",
+        learning_rate_eta0=0.02, dtype="float64", n_iterations=1000,
+        eval_every=100, edge_drop_prob=0.2, burst_len=8.0,
+        mttf=60.0, mttr=25.0,
+    )
+    r_gt = jax_backend.run(gt_cfg, ds, f_opt, return_state=True)
+    gt_row = record("gt_churn_frozen", gt_cfg, r_gt)
+    resid = float(np.abs(
+        r_gt.final_state["y"].mean(axis=0)
+        - r_gt.final_state["g_prev"].mean(axis=0)
+    ).max())
+    gt_row["tracking_invariant_residual"] = resid
+    tl_gt = build_fault_timeline(
+        topo, gt_cfg.n_iterations, gt_cfg.seed, edge_drop_prob=0.2,
+        burst_len=8.0, mttf=60.0, mttr=25.0,
+    )
+    gt_row["node_downtime"] = [round(float(v), 4) for v in
+                               node_downtime(tl_gt)]
+    gt_row["outages"] = outage_stats(tl_gt)
+    # Gate 3: the invariant survives churn with frozen rejoin.
+    assert gt_row["outages"]["n_outages"] > 0, "churn produced no outages"
+    assert resid < 1e-9, (
+        f"GT tracking invariant must survive churn (residual {resid:.2e})"
+    )
+
+    # --- rejoin policy after long outages --------------------------------
+    outage_cfg = base.replace(
+        n_iterations=2000, eval_every=100, mttf=400.0, mttr=150.0,
+    )
+    frozen = record("outage_frozen", outage_cfg,
+                    jax_backend.run(outage_cfg, ds, f_opt))
+    restart_cfg = outage_cfg.replace(rejoin="neighbor_restart")
+    restart = record("outage_neighbor_restart", restart_cfg,
+                     jax_backend.run(restart_cfg, ds, f_opt))
+    tl_out = build_fault_timeline(
+        topo, outage_cfg.n_iterations, outage_cfg.seed, mttf=400.0,
+        mttr=150.0,
+    )
+    stats = outage_stats(tl_out)
+    frozen["outages"] = restart["outages"] = stats
+    # Gate 4: after long outages, the warm restart ends at-or-below the
+    # stale-state policy's consensus error.
+    assert stats["max_outage_rounds"] >= 50, (
+        "seed produced no long outage; the comparison would be vacuous"
+    )
+    assert (
+        restart["final_consensus"] <= frozen["final_consensus"]
+    ), (
+        f"neighbor_restart ({restart['final_consensus']:.3e}) must end "
+        f"<= frozen ({frozen['final_consensus']:.3e}) after long outages"
+    )
+
+    payload = {
+        "device": str(jax.devices()[0]),
+        "config": (
+            f"quadratic N=16 ring T=3000; matched marginal edge drop "
+            f"p={P}, Gilbert-Elliott burst sweep x{BURSTS}; GT churn "
+            "mttf=60/mttr=25 (f64, frozen); rejoin study mttf=400/mttr=150"
+        ),
+        "note": (
+            "Matched-marginal burst sweep: every burst level drops the "
+            "same ~30% of edge-rounds, yet consensus error degrades "
+            "monotonely with burst length because the windowed-union-"
+            "connectivity diagnostic B-hat (the quantity the time-varying-"
+            "gossip rates actually depend on) stretches with correlation. "
+            "burst_1 is asserted bitwise-equal to the iid baseline; the "
+            "GT tracking invariant is asserted to survive crash-recovery "
+            "churn with frozen rejoin; neighbor_restart is asserted to "
+            "end at-or-below frozen on consensus error after long "
+            "outages."
+        ),
+        "gates": {
+            "burst1_bitwise_iid": True,
+            "monotone_consensus_degradation": cons,
+            "monotone_gap_degradation": gaps,
+            "bhat_by_burst": {f"{k:g}": v for k, v in bhat.items()},
+            "gt_tracking_invariant_residual": resid,
+            "rejoin_final_consensus": {
+                "frozen": frozen["final_consensus"],
+                "neighbor_restart": restart["final_consensus"],
+            },
+        },
+        "runs": results,
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    print(json.dumps({"metric": "churn_variants_measured",
+                      "value": len(results)}))
+
+
+if __name__ == "__main__":
+    main()

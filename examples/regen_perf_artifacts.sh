@@ -18,6 +18,7 @@ python examples/bench_breakdown.py         # -> docs/perf/breakdown.json
 python examples/bench_scaling.py           # -> docs/perf/scaling.json + figure
 python examples/bench_presets.py           # -> docs/perf/presets.json
 python examples/bench_faults.py            # -> docs/perf/faults.json
+python examples/bench_churn.py             # -> docs/perf/churn.json
 python examples/bench_byzantine.py         # -> docs/perf/byzantine.json
 python examples/bench_sparse_mixing.py     # -> docs/perf/sparse_mixing.json
 python examples/bench_compute_bound.py     # -> docs/perf/compute_bound.json

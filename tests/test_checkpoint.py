@@ -6,6 +6,8 @@ histories) of an uninterrupted run — possible because batch sampling derives
 keys purely from (seed, iteration), never from carried RNG state.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -215,4 +217,110 @@ def test_no_resume_clears_stale_directory(data, tmp_path):
     )
     np.testing.assert_allclose(
         resumed.final_models, full.final_models, rtol=1e-6, atol=1e-7
+    )
+
+
+def test_restore_falls_back_on_corrupt_latest_chunk(data, tmp_path):
+    """Crash-mid-save robustness (ISSUE 2): a latest chunk directory that
+    exists but cannot be restored (truncated orbax payload) must produce a
+    warning and a fall-back to the previous intact chunk — and the resumed
+    run still ends exactly where the uninterrupted run does (all RNG is
+    (seed, t)-derived, so re-executing the lost chunks is free)."""
+    import shutil
+
+    ds, f_opt = data
+    ckdir = str(tmp_path / "ck")
+    full = jax_backend.run(
+        CFG, ds, f_opt, checkpoint=CheckpointOptions(ckdir + "_ref")
+    )
+    jax_backend.run(
+        CFG, ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir, every_evals=3, max_to_keep=5),
+    )
+    ck = RunCheckpointer(CheckpointOptions(ckdir))
+    latest = ck.latest_chunk()
+    assert latest == 10
+    # Truncate the latest chunk dir: keep the directory (it still LOOKS
+    # like a completed chunk) but gut the orbax payload.
+    step_dir = ck._step_dir(latest)
+    for name in os.listdir(step_dir):
+        p = os.path.join(step_dir, name)
+        shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+    with open(os.path.join(step_dir, "garbage"), "w") as f:
+        f.write("crashed mid-save")
+
+    with pytest.warns(UserWarning, match="partial or corrupt"):
+        restored = ck.restore()
+    assert restored is not None
+    assert restored[-1] < latest  # fell back to an earlier intact chunk
+
+    with pytest.warns(UserWarning, match="partial or corrupt"):
+        resumed = jax_backend.run(
+            CFG, ds, f_opt,
+            checkpoint=CheckpointOptions(ckdir, every_evals=3, max_to_keep=5),
+        )
+    np.testing.assert_allclose(
+        resumed.final_models, full.final_models, rtol=1e-6, atol=1e-7
+    )
+
+
+def test_completed_chunks_skips_orbax_tmp_and_empty_dirs(tmp_path):
+    ckdir = tmp_path / "ck"
+    ck = RunCheckpointer(CheckpointOptions(str(ckdir)))
+    # Debris a crash can leave behind: orbax staging dirs, an empty chunk
+    # dir (mkdir happened, nothing was written), foreign files.
+    (ckdir / "00000003.orbax-checkpoint-tmp-1712").mkdir()
+    (ckdir / "00000004").mkdir()  # empty — crashed before first write
+    (ckdir / "notes.txt").write_text("junk")
+    assert ck.completed_chunks() == []
+    assert ck.latest_chunk() is None
+    assert ck.restore() is None
+
+
+CHURN_CFG = CFG.replace(
+    edge_drop_prob=0.25, burst_len=6.0, mttf=12.0, mttr=8.0,
+)
+
+
+def test_resume_mid_outage_is_bitwise_exact(data, tmp_path):
+    """ISSUE 2 acceptance: checkpoint mid-burst / mid-outage and resume —
+    the trajectory must be BITWISE identical to the uninterrupted
+    (checkpointed) run, because the fault timeline is rebuilt from
+    (seed, horizon) with no carried chain state."""
+    from distributed_optimization_tpu.parallel import build_topology
+    from distributed_optimization_tpu.parallel.faults import (
+        build_fault_timeline,
+    )
+
+    ds, f_opt = data
+    ckdir = str(tmp_path / "ck")
+    # Verify the interruption point (iteration 20 = chunk 5 of 10) really
+    # falls inside an outage and inside a link burst for this seed.
+    topo = build_topology("ring", CHURN_CFG.n_workers)
+    tl = build_fault_timeline(
+        topo, CHURN_CFG.n_iterations, CHURN_CFG.seed,
+        edge_drop_prob=0.25, burst_len=6.0, mttf=12.0, mttr=8.0,
+    )
+    t_cut = 20
+    assert (~tl.node_up[t_cut]).any(), "no node mid-outage at the cut"
+    assert (~tl.edge_up[t_cut]).any(), "no link mid-burst at the cut"
+
+    full = jax_backend.run(
+        CHURN_CFG, ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir + "_full", every_evals=5),
+    )
+    jax_backend.run(
+        CHURN_CFG.replace(n_iterations=t_cut), ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir, every_evals=5, resume=False),
+    )
+    resumed = jax_backend.run(
+        CHURN_CFG, ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir, every_evals=5),
+    )
+    np.testing.assert_array_equal(resumed.final_models, full.final_models)
+    np.testing.assert_array_equal(
+        resumed.history.objective, full.history.objective
+    )
+    assert resumed.history.total_floats_transmitted == pytest.approx(
+        full.history.total_floats_transmitted
     )

@@ -1,0 +1,323 @@
+"""Temporally-correlated failure tests (ISSUE 2 build target).
+
+Covers the persistent fault processes in ``parallel/faults.py``: the
+Gilbert-Elliott bursty-link chain (matched marginal drop rate, mean burst
+length scaling), crash-recovery churn (geometric MTTF/MTTR holding times,
+whole-outage state freeze), the rejoin policies (frozen vs
+neighbor_restart), the availability/staleness diagnostics (per-node
+downtime, windowed union-graph connectivity B̂), algorithm gating, and
+config validation.  The bitwise reductions to the iid samplers live in
+tests/test_faults.py; the headline burstiness-degradation measurement in
+examples/bench_churn.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend, numpy_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.parallel.faults import (
+    build_fault_timeline,
+    make_faulty_mixing,
+    node_downtime,
+    outage_stats,
+    windowed_connectivity,
+)
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+CFG = ExperimentConfig(
+    n_workers=9, n_samples=360, n_features=10, n_informative_features=6,
+    n_iterations=600, local_batch_size=8, problem_type="quadratic",
+    algorithm="dsgd", topology="ring", eval_every=50,
+)
+
+CHURN = dict(mttf=40.0, mttr=15.0)
+
+
+# --- timeline properties ---------------------------------------------------
+
+
+def test_burst_marginal_matched_and_burst_length_scales():
+    """The Gilbert-Elliott chain keeps the marginal drop rate at p for
+    every burst level while the mean burst length grows ~linearly in B —
+    the matched-marginal property the whole bench design rests on."""
+    topo = build_topology("ring", 8)
+    p, T = 0.3, 30_000
+    means = {}
+    for B in (1.0, 4.0, 16.0):
+        tl = build_fault_timeline(topo, T, 3, edge_drop_prob=p, burst_len=B)
+        drop = 1.0 - tl.edge_up.mean()
+        assert abs(drop - p) < 0.03, (B, drop)
+        lengths = []
+        for e in range(tl.edge_index.shape[0]):
+            run = 0
+            for up in tl.edge_up[:, e]:
+                if not up:
+                    run += 1
+                elif run:
+                    lengths.append(run)
+                    run = 0
+        means[B] = np.mean(lengths)
+        # Expected mean burst = B / (1 - p).
+        assert means[B] == pytest.approx(B / (1.0 - p), rel=0.15), B
+    assert means[1.0] < means[4.0] < means[16.0]
+
+
+def test_churn_downtime_and_outage_durations():
+    topo = build_topology("ring", 8)
+    tl = build_fault_timeline(topo, 20_000, 5, mttf=50.0, mttr=20.0)
+    down = node_downtime(tl)
+    assert down.shape == (8,)
+    # Stationary downtime mttr/(mttf+mttr) = 2/7.
+    assert abs(down.mean() - 20.0 / 70.0) < 0.04
+    stats = outage_stats(tl)
+    assert stats["n_outages"] > 0
+    assert stats["mean_outage_rounds"] == pytest.approx(20.0, rel=0.2)
+    # Rejoin marks exactly the first up-round after each down-run.
+    r = tl.rejoin
+    assert r.sum() > 0
+    prev = np.concatenate([np.ones((1, 8), bool), tl.node_up[:-1]])
+    np.testing.assert_array_equal(r, tl.node_up & ~prev)
+
+
+def test_timeline_is_pure_function_of_seed_and_params():
+    topo = build_topology("grid", 9)
+    kw = dict(edge_drop_prob=0.2, burst_len=6.0, mttf=30.0, mttr=10.0)
+    a = build_fault_timeline(topo, 500, 42, **kw)
+    b = build_fault_timeline(topo, 500, 42, **kw)
+    np.testing.assert_array_equal(a.edge_up, b.edge_up)
+    np.testing.assert_array_equal(a.node_up, b.node_up)
+    c = build_fault_timeline(topo, 500, 43, **kw)
+    assert not np.array_equal(a.edge_up, c.edge_up)
+    # A longer horizon extends, never rewrites, the prefix — the property
+    # resume-exactness under a grown n_iterations relies on.
+    d = build_fault_timeline(topo, 700, 42, **kw)
+    np.testing.assert_array_equal(d.edge_up[:500], a.edge_up)
+    np.testing.assert_array_equal(d.node_up[:500], a.node_up)
+
+
+def test_windowed_connectivity_grows_with_burstiness():
+    """B̂ — the smallest window over which every union graph is connected —
+    is the quantity the time-varying-gossip rates depend on; at MATCHED
+    marginal drop rate it must grow with burst length."""
+    topo = build_topology("ring", 8)
+    p, T = 0.3, 600
+    bhats = []
+    for B in (1.0, 4.0, 16.0):
+        tl = build_fault_timeline(topo, T, 7, edge_drop_prob=p, burst_len=B)
+        bhat = windowed_connectivity(tl, topo)
+        assert bhat is not None
+        bhats.append(bhat)
+    assert bhats[0] < bhats[-1]
+    assert bhats[0] <= bhats[1] <= bhats[2]
+
+
+def test_windowed_connectivity_fault_free_is_one():
+    topo = build_topology("ring", 6)
+    tl = build_fault_timeline(topo, 50, 0, mttf=1e9, mttr=1.0)
+    # Astronomically rare crashes: every round's graph is the full ring.
+    assert windowed_connectivity(tl, topo) == 1
+
+
+# --- mixing semantics under churn -----------------------------------------
+
+
+def test_down_node_mixing_row_is_identity_and_mean_preserved():
+    topo = build_topology("fully_connected", 10)
+    fm = make_faulty_mixing(topo, 0.0, 4, mttf=8.0, mttr=6.0, horizon=100)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((10, 3)),
+                    dtype=jnp.float32)
+    tl = fm.timeline
+    some_down = False
+    for t in range(40):
+        mixed = np.asarray(fm.mix(jnp.asarray(t), x))
+        down = ~tl.node_up[t]
+        some_down = some_down or down.any()
+        np.testing.assert_allclose(
+            mixed[down], np.asarray(x)[down], atol=1e-6
+        )
+        np.testing.assert_allclose(mixed.mean(0), np.asarray(x).mean(0),
+                                   atol=1e-5)
+    assert some_down
+
+
+def test_frozen_rejoin_keeps_stale_state_through_outage():
+    """Through the real jax backend: a node that is down for rounds
+    [a, b) must hold its pre-crash state bitwise for the whole outage."""
+    cfg = CFG.replace(n_iterations=60, eval_every=60, **CHURN)
+    ds = generate_synthetic_dataset(cfg)
+    topo = build_topology("ring", cfg.n_workers)
+    tl = build_fault_timeline(topo, 60, cfg.seed, **CHURN)
+    # Find a node with an outage that ends strictly inside the horizon.
+    target = None
+    for i in range(cfg.n_workers):
+        ups = tl.node_up[:, i]
+        downs = np.flatnonzero(~ups)
+        if downs.size >= 2 and downs[-1] < 59:
+            target = i
+            a = downs[0]
+            break
+    assert target is not None, "seed yields no mid-horizon outage"
+    # State at the iteration just before the crash == state at every
+    # iteration while down (run the backend to successive horizons).
+    r_pre = jax_backend.run(
+        cfg.replace(n_iterations=int(a), eval_every=int(a)), ds, 0.0
+    )
+    # Horizon must land inside the same outage.
+    run_len = 0
+    while a + run_len < 60 and not tl.node_up[a + run_len, target]:
+        run_len += 1
+    mid = int(a + run_len)  # first round the node is back up
+    r_mid = jax_backend.run(
+        cfg.replace(n_iterations=mid, eval_every=mid), ds, 0.0
+    )
+    np.testing.assert_array_equal(
+        r_mid.final_models[target], r_pre.final_models[target]
+    )
+
+
+def test_neighbor_restart_differs_and_tightens_consensus_after_outage():
+    cfg = CFG.replace(
+        n_iterations=400, eval_every=50, mttf=120.0, mttr=60.0,
+    )
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    frozen = jax_backend.run(cfg, ds, f_opt)
+    restart = jax_backend.run(cfg.replace(rejoin="neighbor_restart"), ds,
+                              f_opt)
+    # The policies genuinely diverge (same timeline, different rejoin)...
+    assert not np.array_equal(frozen.final_models, restart.final_models)
+    # ...and the warm restart ends at-or-below the stale-state policy's
+    # consensus error (the bench asserts the same after a LONG outage).
+    assert (
+        restart.history.consensus_error[-1]
+        <= frozen.history.consensus_error[-1] * 1.05
+    )
+
+
+def test_gt_tracking_invariant_survives_churn_frozen():
+    """The GT invariant mean(y) = mean(g_prev) survives whole outages with
+    frozen rejoin: every realized W_t is doubly stochastic with identity
+    rows for down nodes, and the freeze covers all three state leaves."""
+    cfg = CFG.replace(
+        algorithm="gradient_tracking", lr_schedule="constant",
+        learning_rate_eta0=0.02, dtype="float64", n_iterations=400,
+        eval_every=50, edge_drop_prob=0.2, burst_len=8.0, **CHURN,
+    )
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    r = jax_backend.run(cfg, ds, f_opt, return_state=True)
+    y_mean = r.final_state["y"].mean(axis=0)
+    g_mean = r.final_state["g_prev"].mean(axis=0)
+    assert np.linalg.norm(g_mean) > 1e-8
+    assert float(np.abs(y_mean - g_mean).max()) < 1e-10
+
+
+# --- gating / validation ---------------------------------------------------
+
+
+def test_churn_rejected_for_unsupported_algorithms():
+    ds = generate_synthetic_dataset(CFG)
+    for algo in ("extra", "admm", "choco"):
+        with pytest.raises(ValueError, match="unsupported"):
+            jax_backend.run(
+                CFG.replace(algorithm=algo, lr_schedule="constant", **CHURN),
+                ds, 0.0,
+            )
+    with pytest.raises(ValueError, match="churn is unsupported"):
+        jax_backend.run(
+            ExperimentConfig(
+                algorithm="push_sum", topology="directed_ring",
+                n_workers=9, n_samples=360, n_features=10,
+                n_informative_features=6, n_iterations=60,
+                local_batch_size=8, eval_every=10, **CHURN,
+            ),
+            ds, 0.0,
+        )
+    with pytest.raises(ValueError, match="churn is unsupported"):
+        numpy_backend.run(CFG.replace(algorithm="push_sum", **CHURN), ds, 0.0)
+    with pytest.raises(ValueError, match="decentralized"):
+        jax_backend.run(
+            CFG.replace(algorithm="centralized", **CHURN), ds, 0.0
+        )
+    from distributed_optimization_tpu.backends import cpp_backend
+
+    with pytest.raises(ValueError, match="not the native core"):
+        cpp_backend.run(CFG.replace(**CHURN), ds, 0.0)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="burst_len"):
+        ExperimentConfig(edge_drop_prob=0.2, burst_len=0.5)
+    with pytest.raises(ValueError, match="silently ignored"):
+        ExperimentConfig(burst_len=4.0)  # no drop rate to shape
+    with pytest.raises(ValueError, match="set together"):
+        ExperimentConfig(mttf=10.0)
+    with pytest.raises(ValueError, match=">= 1"):
+        ExperimentConfig(mttf=0.5, mttr=2.0)
+    with pytest.raises(ValueError, match="replaces iid stragglers"):
+        ExperimentConfig(straggler_prob=0.2, **CHURN)
+    with pytest.raises(ValueError, match="synchronous"):
+        ExperimentConfig(gossip_schedule="one_peer", **CHURN)
+    with pytest.raises(ValueError, match="rejoin"):
+        ExperimentConfig(rejoin="warm")
+    with pytest.raises(ValueError, match="silently ignored"):
+        ExperimentConfig(rejoin="neighbor_restart")  # no churn, no rejoins
+    # The warm restart averages RAW neighbor rows — it cannot compose with
+    # Byzantine injection/screening without modeling an unrealistically
+    # safe rejoin, so the combination is rejected, not silently mis-modeled.
+    with pytest.raises(ValueError, match="unrealistically safe"):
+        ExperimentConfig(
+            rejoin="neighbor_restart", attack="sign_flip", n_byzantine=2,
+            **CHURN,
+        )
+    with pytest.raises(ValueError, match="unrealistically safe"):
+        ExperimentConfig(
+            rejoin="neighbor_restart", aggregation="trimmed_mean",
+            robust_b=2, **CHURN,
+        )
+    # Valid combinations construct.
+    ExperimentConfig(edge_drop_prob=0.2, burst_len=8.0, **CHURN)
+    ExperimentConfig(rejoin="neighbor_restart", **CHURN)
+
+
+def test_bursty_composes_with_one_peer_and_byzantine():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    # Bursty links under the one-peer matching schedule still converge.
+    op = jax_backend.run(
+        CFG.replace(edge_drop_prob=0.3, burst_len=8.0,
+                    gossip_schedule="one_peer"),
+        ds, f_opt,
+    )
+    assert op.history.objective[-1] < 0.3 * op.history.objective[0]
+    # Bursty links + churn compose with the Byzantine layer through
+    # realized_adjacency (trimmed mean over the per-iteration graph).
+    byz = jax_backend.run(
+        CFG.replace(
+            topology="fully_connected", edge_drop_prob=0.2, burst_len=4.0,
+            attack="sign_flip", n_byzantine=2, attack_scale=2.0,
+            aggregation="trimmed_mean", robust_b=2, partition="shuffled",
+            **CHURN,
+        ),
+        ds, f_opt,
+    )
+    assert np.isfinite(byz.history.objective[-1])
+
+
+def test_burstiness_degrades_convergence_at_matched_marginal():
+    """The headline mechanism, unit-sized: same marginal drop rate, longer
+    bursts ⇒ worse consensus (windowed-connectivity degradation). The
+    full swept + asserted version is examples/bench_churn.py."""
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    cons = {}
+    for B in (1.0, 16.0):
+        r = jax_backend.run(
+            CFG.replace(edge_drop_prob=0.4, burst_len=B), ds, f_opt
+        )
+        cons[B] = float(np.mean(r.history.consensus_error))
+    assert cons[16.0] > cons[1.0]

@@ -96,15 +96,17 @@ def test_deterministic_given_seed(data):
 
 def test_rejects_unsupported(data):
     """All seven algorithms now run on the cpp tier; the remaining carve-outs
-    are fault injection (jax-only) and randomized CHOCO compressors
-    (tested separately)."""
+    are fault injection (jax backend + numpy oracle only) and randomized
+    CHOCO compressors (tested separately)."""
     ds, f_opt = data
     assert set(cpp_backend._SUPPORTED) == {
         "centralized", "dsgd", "gradient_tracking", "extra", "admm", "choco",
         "push_sum",
     }
-    with pytest.raises(ValueError, match="jax-only"):
+    with pytest.raises(ValueError, match="not the native core"):
         cpp_backend.run(CFG.replace(edge_drop_prob=0.2), ds, f_opt)
+    with pytest.raises(ValueError, match="not the native core"):
+        cpp_backend.run(CFG.replace(mttf=40.0, mttr=15.0), ds, f_opt)
 
 
 def test_empty_shards_stay_finite():
