@@ -3,19 +3,20 @@
 
 PY ?= python
 
-.PHONY: test smoke bench-byzantine bench-churn
+.PHONY: test smoke bench-byzantine bench-churn bench-robust-scale
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
-# Fast robustness smoke: fault-injection + churn + Byzantine suites, first
-# failure stops, strict collection (no marker typos, no swallowed import
-# errors).
+# Fast robustness smoke: fault-injection + churn + Byzantine + gather-
+# aggregation suites, first failure stops, strict collection (no marker
+# typos, no swallowed import errors).
 smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest -q -m 'not slow' -x \
-		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py
+		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py \
+		tests/test_robust_gather.py
 
 # Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
 bench-byzantine:
@@ -24,3 +25,9 @@ bench-byzantine:
 # Regenerate the correlated-failure evidence (docs/perf/churn.json).
 bench-churn:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_churn.py
+
+# Regenerate the degree-bounded robust-aggregation scaling evidence
+# (docs/perf/robust_scale.json: gather-vs-dense e2e, asserted >= 5x floor
+# at N=256 ring + crossover cells behind the robust_impl auto gate).
+bench-robust-scale:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_robust_scale.py

@@ -42,6 +42,7 @@ from distributed_optimization_tpu.ops.sampling import (
     sample_worker_batches,
 )
 from distributed_optimization_tpu.ops.robust_aggregation import (
+    make_gather_robust_aggregator,
     make_robust_aggregator,
     validate_budget,
 )
@@ -374,8 +375,16 @@ def run(
     checkpoint=None,
     measure_timestamps: Optional[bool] = None,
     return_state: bool = False,
+    hoisted_min_ratio: Optional[float] = None,
+    eval_hoist_limit: Optional[int] = None,
 ) -> BackendRunResult:
     """Run one experiment on the JAX backend; returns histories + final models.
+
+    ``hoisted_min_ratio`` / ``eval_hoist_limit`` override the module-level
+    eval-cadence-form defaults (HOISTED_MIN_RATIO / EVAL_HOIST_LIMIT) for
+    THIS run only — e.g. ``hoisted_min_ratio=0.0`` forces the hoisted
+    exact-cadence form, ``eval_hoist_limit=0`` forces inline; ``None``
+    keeps the measured defaults.
 
     ``measure_timestamps=True`` executes eval-chunks under a host-driven loop
     recording a real ``perf_counter`` timestamp per eval (one host sync per
@@ -401,6 +410,8 @@ def run(
             measure_compile=measure_compile, checkpoint=checkpoint,
             measure_timestamps=measure_timestamps,
             return_state=return_state,
+            hoisted_min_ratio=hoisted_min_ratio,
+            eval_hoist_limit=eval_hoist_limit,
         )
 
 
@@ -432,8 +443,12 @@ def run(
 # hardware, where a scan region does not cost 180 ms of tunnel sync, the
 # crossover would land where the naive FLOP model predicts), but nothing
 # selects it by default on infrastructure where it measured slower
-# everywhere. Lower the gate (module constant) to re-enable;
-# EVAL_HOIST_LIMIT bounds program size (64 unrolled scan+eval segments).
+# everywhere. These module constants are IMMUTABLE defaults: override per
+# run via the ``hoisted_min_ratio`` / ``eval_hoist_limit`` kwargs of
+# ``run()`` (tests and examples/bench_eval_cadence.py force forms that
+# way — nothing mutates the globals, so concurrent runs cannot race on
+# them). EVAL_HOIST_LIMIT bounds program size (64 unrolled scan+eval
+# segments).
 EVAL_HOIST_LIMIT = 64
 HOISTED_MIN_RATIO = float("inf")
 
@@ -469,6 +484,8 @@ def _run(
     checkpoint=None,
     measure_timestamps: Optional[bool] = None,
     return_state: bool = False,
+    hoisted_min_ratio: Optional[float] = None,
+    eval_hoist_limit: Optional[int] = None,
 ) -> BackendRunResult:
     """Backend implementation (see ``run``).
 
@@ -611,30 +628,64 @@ def _run(
                 n, config.attack, config.n_byzantine, config.attack_scale,
                 config.seed,
             )
-            robust_aggregate = None
-            adj_fn = None
+            robust_aggregate_t = None
             if config.aggregation != "gossip" and config.robust_b > 0:
                 validate_budget(
                     int(topo.degrees.min()), config.robust_b,
                     config.aggregation,
                 )
-                robust_aggregate = make_robust_aggregator(
-                    config.aggregation, config.robust_b, config.clip_tau
+                # The screened-rule execution form (docs/BYZANTINE.md
+                # "Degree-bounded gather path"): 'gather' screens over the
+                # static [N, k_max] neighbor table — O(N·k_max·d·log k_max)
+                # — instead of the dense [N, N, d] node-axis sort; 'auto'
+                # routes by the measured crossover (resolved_robust_impl).
+                # Both forms bind the rule to the SAME per-iteration fault
+                # realization, in dense-adjacency or gathered-slot form.
+                robust_impl = config.resolved_robust_impl(
+                    int(topo.degrees.max())
                 )
-                if faulty is not None:
-                    adj_fn = faulty.realized_adjacency
-                else:
-                    static_A = jnp.asarray(
-                        topo.adjacency, dtype=jnp.float32
+                if robust_impl == "gather":
+                    from distributed_optimization_tpu.parallel.topology import (
+                        neighbor_table,
                     )
-                    adj_fn = lambda t: static_A  # noqa: E731
+
+                    nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+                    gather_agg = make_gather_robust_aggregator(
+                        config.aggregation, config.robust_b, nbr_idx,
+                        config.clip_tau,
+                    )
+                    if faulty is not None:
+                        live_fn = faulty.make_neighbor_liveness(
+                            nbr_idx, nbr_mask
+                        )
+                    else:
+                        static_live = jnp.asarray(
+                            nbr_mask, dtype=jnp.float32
+                        )
+                        live_fn = lambda t: static_live  # noqa: E731
+                    robust_aggregate_t = (
+                        lambda t, v: gather_agg(live_fn(t), v)  # noqa: E731
+                    )
+                else:
+                    dense_agg = make_robust_aggregator(
+                        config.aggregation, config.robust_b, config.clip_tau
+                    )
+                    if faulty is not None:
+                        adj_fn = faulty.realized_adjacency
+                    else:
+                        static_A = jnp.asarray(
+                            topo.adjacency, dtype=jnp.float32
+                        )
+                        adj_fn = lambda t: static_A  # noqa: E731
+                    robust_aggregate_t = (
+                        lambda t, v: dense_agg(adj_fn(t), v)  # noqa: E731
+                    )
             if faulty is not None:
                 base_mix_t = faulty.mix
             else:
                 base_mix_t = lambda t, v: mix_op.apply(v)  # noqa: E731
             byz_mix = make_byzantine_mixing(
-                adversary, base_mix_t,
-                aggregate=robust_aggregate, realized_adjacency=adj_fn,
+                adversary, base_mix_t, aggregate_t=robust_aggregate_t,
             )
     else:
         if (
@@ -987,11 +1038,18 @@ def _run(
         # SEGMENT, so coarse-cadence checkpointed runs on huge datasets
         # get exact-cadence evals even when the run's total eval count is
         # large.
+        hoist_limit = (
+            EVAL_HOIST_LIMIT if eval_hoist_limit is None else eval_hoist_limit
+        )
+        min_ratio = (
+            HOISTED_MIN_RATIO if hoisted_min_ratio is None
+            else hoisted_min_ratio
+        )
         use_hoisted = (
             collect_metrics
             and trips_per_eval > 1
-            and per_scan_evals <= EVAL_HOIST_LIMIT
-            and eval_dominance_ratio >= HOISTED_MIN_RATIO
+            and per_scan_evals <= hoist_limit
+            and eval_dominance_ratio >= min_ratio
         )
 
         def make_microchunk(data):

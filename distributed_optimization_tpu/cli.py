@@ -232,6 +232,17 @@ def build_parser() -> argparse.ArgumentParser:
     opt.add_argument("--clip-tau", type=float, default=_DEFAULTS.clip_tau,
                      help="fixed clipping radius for clipped_gossip "
                           "(0 = adaptive per-node radius)")
+    opt.add_argument("--robust-impl", choices=("auto", "dense", "gather"),
+                     default=_DEFAULTS.robust_impl,
+                     help="execution form of the robust rule (jax "
+                          "backend): 'dense' sorts the [N,N,d] closed-"
+                          "neighborhood tensor (O(N^2 d log N)); 'gather' "
+                          "screens over a static [N,k_max] padded "
+                          "neighbor table (O(N k_max d log k_max), "
+                          "~N/k_max less work on degree-bounded graphs); "
+                          "'auto' = measured rule: gather unless the graph "
+                          "is fully connected (k_max+1 = N, where the two "
+                          "tie — docs/perf/robust_scale.json)")
     opt.add_argument("--partition", choices=("sorted", "shuffled"),
                      default=_DEFAULTS.partition,
                      help="worker data split: 'sorted' = the study's "
@@ -352,6 +363,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         aggregation=args.aggregation,
         robust_b=args.robust_b,
         clip_tau=args.clip_tau,
+        robust_impl=args.robust_impl,
         partition=args.partition,
         gossip_schedule=args.gossip_schedule,
         mixing_impl=args.mixing_impl,

@@ -37,6 +37,27 @@ The ``*_np`` twin is an independent per-node loop implementation written
 directly from the rule definitions (numpy-oracle convention, see
 ``backends/numpy_backend.py``): equivalence between the vectorized jax
 forms and this oracle is pinned in tests/test_byzantine.py.
+
+Two jax implementations of every rule (``robust_impl`` knob):
+
+- **dense** (``make_robust_aggregator``): materializes the [N, N, d]
+  closed-neighborhood tensor and sorts over the full node axis —
+  O(N²·d·log N) work, O(N²·d) memory, regardless of how sparse the
+  topology is;
+- **gather** (``make_gather_robust_aggregator``): precomputes a static
+  padded neighbor-index table [N, k_max] from the topology
+  (``parallel/topology.py::neighbor_table``), gathers neighbor models to
+  [N, k_max, d] and per-incident-edge liveness bits to [N, k_max], and
+  sorts/trims/medians/clips over the k_max axis — O(N·k_max·d·log k_max)
+  work and O(N·k_max·d) memory, an ~N/k_max-fold reduction on
+  degree-bounded graphs (measured 69-75× e2e for trimmed mean/median on
+  an N=256 ring, docs/perf/robust_scale.json).
+
+The two are algebraically identical: the gather sort sees the same finite
+values (+inf padding beyond the realized neighborhood, same convention),
+neighbor slots are ordered ascending by index (the order a dense axis-1
+reduction visits them), and f64 parity ≤ 1e-12 across dense / gather /
+the numpy oracle is asserted in tests/test_robust_gather.py.
 """
 
 from __future__ import annotations
@@ -175,6 +196,115 @@ def make_robust_aggregator(
             )
             # Off-graph entries have W_ij = 0; the diagonal difference is 0.
             moved = jnp.sum(W[:, :, None] * diffs * factor[:, :, None], axis=1)
+            return (xa + moved).astype(x.dtype)
+
+    return aggregate
+
+
+def make_gather_robust_aggregator(
+    name: str,
+    budget: int,
+    nbr_idx: np.ndarray,
+    clip_tau: float = 0.0,
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Degree-bounded ``aggregate(live, x) -> x_new`` for one rule.
+
+    ``nbr_idx``: the static [N, k_max] padded neighbor-index table of the
+    BASE topology (``parallel/topology.py::neighbor_table``; padded slots
+    point at self). ``live``: per-incident-edge 0/1 liveness bits
+    [N, k_max] for this round — the gather-form realized adjacency
+    (``FaultyMixing.neighbor_liveness``, or the static ``nbr_mask`` when
+    fault-free); symmetric by construction, so a neighbor's realized
+    degree is recoverable by gathering row sums. ``x``: the [N, d] stack
+    AS TRANSMITTED, like the dense form.
+
+    Each rule mirrors its dense twin term for term over the k_max axis —
+    same +inf padding, same accumulation dtype floor, same identity-row
+    degradation for faulted-down neighborhoods (realized closed
+    neighborhood ≤ 2b, or deg ≤ b for adaptive clipping) — but the sort,
+    rank selection, and neighbor reduction are O(k_max), not O(N).
+    """
+    if name not in AGGREGATIONS or name == "gossip":
+        raise ValueError(
+            f"no robust aggregator named {name!r}; plain gossip is built by "
+            "ops/mixing.py / parallel/faults.py"
+        )
+    if budget < 1:
+        raise ValueError(
+            f"{name} needs a positive attack budget, got {budget}"
+        )
+    nbr = jnp.asarray(nbr_idx, dtype=jnp.int32)  # [N, k_max]
+    k_max = nbr.shape[1]
+
+    def _closed_sorted(live, x):
+        """Ascending per-coordinate sort of the realized closed
+        neighborhood over the slot axis: [N, k_max+1, d] (self in slot 0
+        pre-sort; +inf beyond each row's realized count) + counts [N]."""
+        vals = jnp.where(live[:, :, None] > 0, x[nbr], jnp.inf)
+        closed = jnp.concatenate([x[:, None, :], vals], axis=1)
+        return jnp.sort(closed, axis=1), jnp.sum(live, axis=1) + 1.0
+
+    if name == "trimmed_mean":
+
+        def aggregate(live, x):
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            xa = x.astype(acc)
+            s, counts = _closed_sorted(live.astype(acc), xa)
+            pos = jnp.arange(k_max + 1, dtype=acc)
+            keep = (pos[None, :] >= budget) & (
+                pos[None, :] < (counts - budget)[:, None]
+            )
+            kept = jnp.maximum(counts - 2 * budget, 0.0)
+            total = jnp.sum(jnp.where(keep[:, :, None], s, 0.0), axis=1)
+            mean = total / jnp.maximum(kept, 1.0)[:, None]
+            # Faulted-down neighborhoods (c_i ≤ 2b): identity row.
+            return jnp.where(
+                (kept >= 1.0)[:, None], mean, xa
+            ).astype(x.dtype)
+
+    elif name == "median":
+
+        def aggregate(live, x):
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            xa = x.astype(acc)
+            s, counts = _closed_sorted(live.astype(acc), xa)
+            c = counts.astype(jnp.int32)
+            lo = jnp.maximum((c - 1) // 2, 0)[:, None, None]
+            hi = jnp.maximum(c // 2, 0)[:, None, None]
+            med = 0.5 * (
+                jnp.take_along_axis(s, lo, axis=1)
+                + jnp.take_along_axis(s, hi, axis=1)
+            )
+            return med[:, 0, :].astype(x.dtype)
+
+    else:  # clipped_gossip
+
+        def aggregate(live, x):
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            xa = x.astype(acc)
+            lv = live.astype(acc)
+            deg = jnp.sum(lv, axis=1)  # realized degrees [N]
+            diffs = xa[nbr] - xa[:, None, :]  # [recv i, slot, d]
+            norms = jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
+            if clip_tau > 0.0:
+                tau = jnp.full(nbr.shape[0], clip_tau, dtype=acc)
+            else:
+                # Adaptive radius: the (deg−b)-th smallest realized
+                # neighbor distance; deg ≤ b ⇒ τ = 0 (identity row).
+                degi = deg.astype(jnp.int32)
+                masked = jnp.where(lv > 0, norms, jnp.inf)
+                ranked = jnp.sort(masked, axis=1)
+                k = jnp.clip(degi - budget - 1, 0, k_max - 1)
+                kth = jnp.take_along_axis(ranked, k[:, None], axis=1)[:, 0]
+                tau = jnp.where(degi - budget >= 1, kth, 0.0)
+            # MH weights on realized degrees, gather form: the liveness is
+            # symmetric, so a neighbor's realized degree is its row sum
+            # gathered through the slot table; dead slots carry lv = 0.
+            w = lv / (1.0 + jnp.maximum(deg[:, None], deg[nbr]))
+            factor = jnp.minimum(
+                1.0, tau[:, None] / jnp.maximum(norms, jnp.finfo(acc).tiny)
+            )
+            moved = jnp.sum(w[:, :, None] * diffs * factor[:, :, None], axis=1)
             return (xa + moved).astype(x.dtype)
 
     return aggregate

@@ -138,17 +138,18 @@ def make_byzantine_mixing(
     adversary: Optional[Adversary],
     base_mix: Callable[[jax.Array, jax.Array], jax.Array],
     *,
-    aggregate=None,
-    realized_adjacency=None,
+    aggregate_t=None,
 ) -> Callable[[jax.Array, jax.Array], jax.Array]:
     """Compose corruption and (robust) aggregation into one mix(t, x).
 
     ``base_mix(t, x)``: the benign time-varying gossip (static MixingOp or
     FaultyMixing) — used when no robust rule is active, i.e. the
-    VULNERABLE baseline the breakdown benches measure. With ``aggregate``
-    (an ``ops.robust_aggregation`` rule) the mix instead screens the
-    corrupted stack over ``realized_adjacency(t)``, so attacks, edge
-    faults, and the defense all see the same per-iteration graph.
+    VULNERABLE baseline the breakdown benches measure. With
+    ``aggregate_t(t, x)`` (an ``ops.robust_aggregation`` rule bound by the
+    backend to its per-iteration graph source — the dense realized
+    adjacency or the gather-form neighbor liveness, per ``robust_impl``)
+    the mix instead screens the corrupted stack, so attacks, edge faults,
+    and the defense all see the same per-iteration realization.
     ``adversary=None`` gives the pure-defense path (robust rule, no
     attackers).
 
@@ -163,16 +164,11 @@ def make_byzantine_mixing(
     corrupt = (
         adversary.corrupt if adversary is not None else (lambda t, x: x)
     )
-    if aggregate is not None and realized_adjacency is None:
-        raise ValueError(
-            "robust aggregation needs the realized adjacency per "
-            "iteration (static topology or FaultyMixing.realized_adjacency)"
-        )
 
     def honest_view(t, x):
         xa = corrupt(t, x)
-        if aggregate is not None:
-            return aggregate(realized_adjacency(t), xa)
+        if aggregate_t is not None:
+            return aggregate_t(t, xa)
         return base_mix(t, xa)
 
     if adversary is None:

@@ -154,6 +154,19 @@ class FaultyMixing:
     # exchanges cannot realize a screening budget (config rejects the
     # combination).
     realized_adjacency: Optional[Callable[[jax.Array], jax.Array]] = None
+    # ``make_neighbor_liveness(nbr_idx, nbr_mask)``: build the GATHER form
+    # of the realized adjacency for the degree-bounded robust-aggregation
+    # path — returns ``live(t) -> [N, k_max]`` float32 per-incident-edge
+    # liveness bits over the topology's static padded neighbor table
+    # (``parallel/topology.py::neighbor_table``). Bit-for-bit the same
+    # realization as ``realized_adjacency(t)`` gathered per slot: the
+    # timeline path indexes the precomputed [horizon, E] edge chains
+    # through a (node, slot) → edge-id table instead of scattering a dense
+    # [N, N] matrix; the memoryless path consumes the SAME counter-based
+    # (seed, t) uniform draw as the dense sampler, gathered at the slot's
+    # (i, j) entry. None for matching schedules (no screening budget is
+    # realizable) and directed graphs (no gather screening path).
+    make_neighbor_liveness: Optional[Callable[..., Callable]] = None
     # --- persistent fault processes (None/0/False when memoryless) ---
     # Crash-recovery churn is active (the backend must freeze DOWN nodes'
     # state, exactly like stragglers, for the whole outage).
@@ -711,6 +724,68 @@ def make_faulty_mixing(
                 A_t = A_t * m[:, None] * m[None, :]  # exchanges nothing
             return A_t
 
+    def make_neighbor_liveness(nbr_idx: np.ndarray, nbr_mask: np.ndarray):
+        """Gather-form realized adjacency (see the FaultyMixing field doc).
+
+        Host tables come from the caller (built once when the gather
+        screening path is selected); the returned ``live(t)`` is
+        jit-gatherable and consumes exactly the draws/chains the dense
+        ``realized_adjacency`` consumes, so the two forms realize the
+        identical graph at every t in every precision.
+        """
+        n = base_A.shape[0]
+        nbr_dev = jnp.asarray(nbr_idx, dtype=jnp.int32)
+        mask_dev = jnp.asarray(nbr_mask, dtype=jnp.float32)
+        if use_timeline:
+            slot_dev = None
+            if timeline.edge_up is not None:
+                from distributed_optimization_tpu.parallel.topology import (
+                    incident_edge_slots,
+                )
+
+                slot_dev = jnp.asarray(
+                    incident_edge_slots(
+                        nbr_idx, nbr_mask, timeline.edge_index
+                    ),
+                    dtype=jnp.int32,
+                )
+                edge_up_gather = jnp.asarray(timeline.edge_up)
+
+            def live(t) -> jax.Array:
+                out = mask_dev
+                if slot_dev is not None:
+                    out = out * edge_up_gather[t].astype(jnp.float32)[
+                        slot_dev
+                    ]
+                if timeline.node_up is not None:
+                    m = active(t)
+                    out = out * m[:, None] * m[nbr_dev]
+                return out
+        else:
+
+            def live(t) -> jax.Array:
+                if drop_prob == 0.0 and straggler_prob == 0.0:
+                    return mask_dev  # fault-free fast path: static table
+                out = mask_dev
+                if drop_prob > 0.0:
+                    # The SAME symmetric (seed, t) draw as
+                    # sample_surviving_adjacency, gathered per slot — the
+                    # O(N²) uniform matrix carries no d factor, so the
+                    # degree-bounded complexity claim is untouched.
+                    key = jax.random.fold_in(fault_key, t)
+                    u = jax.random.uniform(key, (n, n), dtype=jnp.float32)
+                    u = jnp.triu(u, 1)
+                    u = u + u.T
+                    out = out * (
+                        jnp.take_along_axis(u, nbr_dev, axis=1) >= drop_prob
+                    ).astype(jnp.float32)
+                if straggler_prob > 0.0:
+                    m = active(t)
+                    out = out * m[:, None] * m[nbr_dev]
+                return out
+
+        return live
+
     rejoin_restart = None
     if churn_active and rejoin == "neighbor_restart":
         rejoin_dev = jnp.asarray(timeline.rejoin)
@@ -778,6 +853,11 @@ def make_faulty_mixing(
         drop_prob=drop_prob,
         straggler_prob=straggler_prob,
         realized_adjacency=exposed_adjacency,
+        make_neighbor_liveness=(
+            make_neighbor_liveness
+            if exposed_adjacency is not None and not topo.directed
+            else None
+        ),
         churn_active=churn_active,
         rejoin=rejoin,
         rejoin_restart=rejoin_restart,

@@ -204,9 +204,13 @@ def build_tp_softmax_dsgd(
             return data_term + 0.5 * reg * sq
 
         def step(Wcur, t):
+            # t is an int32 scan index; the schedule is computed in the
+            # carry dtype so f64 runs match the replicated backend's
+            # eta0/sqrt(t+1) bit for bit (an f32 arange here drifted ~4e-8
+            # relative per step against the f64 oracles — round-5 ADVICE).
             eta = (
-                eta0 / jnp.sqrt(t + 1.0) if sqrt_decay
-                else jnp.asarray(eta0)
+                eta0 / jnp.sqrt((t + 1.0).astype(Wcur.dtype)) if sqrt_decay
+                else jnp.asarray(eta0, dtype=Wcur.dtype)
             ).astype(Wcur.dtype)
             g = grad(Wcur)
             # D-PSGD: grads at the pre-mix models; boundary ppermutes
@@ -221,10 +225,10 @@ def build_tp_softmax_dsgd(
         # flat scan, no segments.
         if not collect_metrics:
             Wcur, _ = jax.lax.scan(
-                step, Wb, jnp.arange(T, dtype=jnp.float32)
+                step, Wb, jnp.arange(T, dtype=jnp.int32)
             )
             return Wcur, jnp.zeros(n_evals, dtype=Wb.dtype)
-        ts = jnp.arange(T, dtype=jnp.float32).reshape(n_evals, eval_every)
+        ts = jnp.arange(T, dtype=jnp.int32).reshape(n_evals, eval_every)
         outs = []
         Wcur = Wb
         for e in range(n_evals):

@@ -224,6 +224,64 @@ def _is_connected(adj: np.ndarray) -> bool:
     return bool(reached.all())
 
 
+def neighbor_table(adjacency: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Static padded neighbor-index table of an undirected 0/1 adjacency.
+
+    Returns ``(nbr_idx [N, k_max] int32, nbr_mask [N, k_max] bool)``: row i
+    lists i's neighbors in ascending index order (the same order a dense
+    axis-1 reduction visits them, so gather-form aggregations sum in the
+    identical order as their dense twins); padded slots point at i itself
+    (an always-in-bounds gather target) with mask False. ``k_max`` is the
+    maximum degree — the whole point of the gather path is that sorts and
+    reductions then run over k_max+1 values instead of N
+    (``ops/robust_aggregation.py::make_gather_robust_aggregator``).
+
+    Host-side like everything in this module: built once per run, outside
+    ``jit``. Directed graphs are rejected — the degree-bounded screening
+    path is undirected-only (robust aggregation composes only with MH
+    gossip; the directed/push-sum family rejects Byzantine injection).
+    """
+    A = np.asarray(adjacency)
+    if not np.array_equal(A, A.T):
+        raise ValueError(
+            "neighbor_table expects an undirected (symmetric) adjacency; "
+            "the degree-bounded gather path has no directed form"
+        )
+    n = A.shape[0]
+    k_max = max(int(A.sum(axis=1).max()), 1) if n else 1
+    nbr_idx = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, k_max))
+    nbr_mask = np.zeros((n, k_max), dtype=bool)
+    for i in range(n):
+        nbrs = np.nonzero(A[i])[0]
+        nbr_idx[i, : len(nbrs)] = nbrs
+        nbr_mask[i, : len(nbrs)] = True
+    return nbr_idx, nbr_mask
+
+
+def incident_edge_slots(
+    nbr_idx: np.ndarray, nbr_mask: np.ndarray, edge_index: np.ndarray
+) -> np.ndarray:
+    """[N, k_max] int32 map from (node, neighbor-slot) to undirected edge id.
+
+    ``edge_index`` is the [E, 2] i<j edge list a fault timeline indexes
+    (``parallel/faults.py``); entry (i, s) is the id of edge
+    {i, nbr_idx[i, s]} — each edge appears in BOTH endpoints' rows, so a
+    per-edge liveness bit gathered through this table lands symmetrically,
+    exactly like the dense scatter ``A[ei, ej] = A[ej, ei] = up[e]``.
+    Padded slots map to 0 (masked out by ``nbr_mask`` downstream).
+    """
+    edge_id = {
+        (int(i), int(j)): e for e, (i, j) in enumerate(edge_index)
+    }
+    slots = np.zeros(nbr_idx.shape, dtype=np.int32)
+    for i in range(nbr_idx.shape[0]):
+        for s in range(nbr_idx.shape[1]):
+            if nbr_mask[i, s]:
+                j = int(nbr_idx[i, s])
+                slots[i, s] = edge_id[(min(i, j), max(i, j))]
+    return slots
+
+
 def metropolis_hastings_weights(adjacency: np.ndarray) -> np.ndarray:
     """Metropolis-Hastings mixing matrix from an adjacency matrix.
 

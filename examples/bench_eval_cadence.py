@@ -93,24 +93,18 @@ def main() -> None:
     N, b, d = 256, 16, 80
 
     def run_form(cfg, ds, form):
-        """Force one execution form via the backend's public gates."""
-        saved = (jax_backend.EVAL_HOIST_LIMIT,
-                 jax_backend.HOISTED_MIN_RATIO)
-        try:
-            if form == "inline":
-                jax_backend.EVAL_HOIST_LIMIT = 0
-                r = jax_backend.run(cfg, ds, 0.0, measure_compile=False,
-                                    measure_timestamps=False)
-            elif form == "hoisted":
-                jax_backend.HOISTED_MIN_RATIO = 0.0
-                r = jax_backend.run(cfg, ds, 0.0, measure_compile=False,
-                                    measure_timestamps=False)
-            else:  # chunked
-                r = jax_backend.run(cfg, ds, 0.0, measure_compile=False,
-                                    measure_timestamps=True)
-        finally:
-            (jax_backend.EVAL_HOIST_LIMIT,
-             jax_backend.HOISTED_MIN_RATIO) = saved
+        """Force one execution form via run()'s per-run gate kwargs (the
+        module globals are immutable defaults — nothing to save/restore)."""
+        if form == "inline":
+            r = jax_backend.run(cfg, ds, 0.0, measure_compile=False,
+                                measure_timestamps=False, eval_hoist_limit=0)
+        elif form == "hoisted":
+            r = jax_backend.run(cfg, ds, 0.0, measure_compile=False,
+                                measure_timestamps=False,
+                                hoisted_min_ratio=0.0)
+        else:  # chunked
+            r = jax_backend.run(cfg, ds, 0.0, measure_compile=False,
+                                measure_timestamps=True)
         return float(r.history.iters_per_second)
 
     # --- 1. coarse cadence: hoisted vs inline across n_samples ------------
@@ -180,8 +174,8 @@ def main() -> None:
             "interleaved cycles passing the physical floor (see script "
             "docstring; raw readings recorded), compile excluded. "
             "Section 1: T=20k, eval_every=4k (n_evals=5), hoisted forced "
-            "via HOISTED_MIN_RATIO=0 vs inline forced via "
-            "EVAL_HOIST_LIMIT=0; eval_dominance_ratio = n_samples / "
+            "via run(hoisted_min_ratio=0) vs inline forced via "
+            "run(eval_hoist_limit=0); eval_dominance_ratio = n_samples / "
             "(2*micro*N*b) is the quantity HOISTED_MIN_RATIO gates on. "
             "Section 2: S=2M, eval_every=100 (n_evals=40), the three "
             "forms head-to-head."

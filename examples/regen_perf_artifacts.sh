@@ -20,6 +20,7 @@ python examples/bench_presets.py           # -> docs/perf/presets.json
 python examples/bench_faults.py            # -> docs/perf/faults.json
 python examples/bench_churn.py             # -> docs/perf/churn.json
 python examples/bench_byzantine.py         # -> docs/perf/byzantine.json
+python examples/bench_robust_scale.py      # -> docs/perf/robust_scale.json
 python examples/bench_sparse_mixing.py     # -> docs/perf/sparse_mixing.json
 python examples/bench_compute_bound.py     # -> docs/perf/compute_bound.json
 python examples/bench_eval_cadence.py      # -> docs/perf/eval_cadence.json

@@ -1,0 +1,359 @@
+"""Degree-bounded gather robust aggregation (docs/BYZANTINE.md §gather).
+
+The gather form (``make_gather_robust_aggregator`` + the static neighbor
+table + per-incident-edge liveness bits) must be an EXECUTION change only:
+same screened aggregate as the dense [N, N, d] form and the per-node numpy
+oracle at f64 parity ≤ 1e-12, under arbitrary realized graphs, composed
+fault processes (bursty links + crash-recovery churn + Byzantine
+injection), checkpoint/resume, and the faulted-down identity-row
+degradation at the k_max boundary. Plus the routing contract: the 'auto'
+gate picks gather exactly when the measured crossover says it wins
+(k_max + 1 < N, i.e. everywhere but fully connected) and the knob is
+rejected where it would be silently ignored.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend, numpy_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.ops.robust_aggregation import (
+    make_gather_robust_aggregator,
+    make_robust_aggregator,
+    robust_aggregate_np,
+)
+from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.parallel._compat import enable_x64
+from distributed_optimization_tpu.parallel.faults import make_faulty_mixing
+from distributed_optimization_tpu.parallel.topology import (
+    incident_edge_slots,
+    neighbor_table,
+)
+
+RULES = ("trimmed_mean", "median", "clipped_gossip")
+
+
+def _gather_live(A, nbr_idx, nbr_mask):
+    """Host-side reference liveness: the realized adjacency gathered per
+    neighbor slot (what ``FaultyMixing.make_neighbor_liveness`` produces
+    on-device)."""
+    return np.take_along_axis(np.asarray(A), nbr_idx, axis=1) * nbr_mask
+
+
+# ------------------------------------------------------------- table builder
+
+def test_neighbor_table_shape_order_and_padding():
+    topo = build_topology("erdos_renyi", 12, erdos_renyi_p=0.5, seed=7)
+    nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+    k_max = int(topo.degrees.max())
+    assert nbr_idx.shape == nbr_mask.shape == (12, k_max)
+    for i in range(12):
+        nbrs = np.nonzero(topo.adjacency[i])[0]
+        # Ascending neighbor order (dense axis-1 visit order), self-padded.
+        np.testing.assert_array_equal(nbr_idx[i, : len(nbrs)], nbrs)
+        assert np.all(nbr_idx[i, len(nbrs):] == i)
+        assert nbr_mask[i].sum() == len(nbrs)
+
+
+def test_neighbor_table_rejects_directed():
+    topo = build_topology("directed_ring", 8)
+    with pytest.raises(ValueError, match="undirected"):
+        neighbor_table(topo.adjacency)
+
+
+def test_incident_edge_slots_are_symmetric():
+    """Edge {i, j}'s timeline bit must land in BOTH endpoints' rows — the
+    gather twin of the dense A[ei, ej] = A[ej, ei] scatter."""
+    from distributed_optimization_tpu.parallel.faults import _edge_list
+
+    topo = build_topology("grid", 16)
+    nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+    edges = _edge_list(topo)
+    slots = incident_edge_slots(nbr_idx, nbr_mask, edges)
+    for e, (i, j) in enumerate(edges):
+        si = np.nonzero(nbr_idx[i] == j)[0][0]
+        sj = np.nonzero(nbr_idx[j] == i)[0][0]
+        assert slots[i, si] == e and slots[j, sj] == e
+
+
+# ----------------------------------------------- unit parity (f64 <= 1e-12)
+
+@pytest.mark.parametrize("rule", RULES)
+@pytest.mark.parametrize(
+    "topo_name,n", [("ring", 16), ("erdos_renyi", 14), ("grid", 16)]
+)
+def test_gather_matches_dense_and_oracle_f64(rule, topo_name, n):
+    """The acceptance parity: gather vs dense vs the per-node numpy oracle
+    at ≤ 1e-12 in float64, over an irregular fault-realized graph with
+    wild (attack-like) rows."""
+    topo = build_topology(topo_name, n, erdos_renyi_p=0.5, seed=3)
+    rng = np.random.default_rng(11)
+    A = np.array(topo.adjacency, copy=True)
+    ei, ej = np.nonzero(np.triu(A, 1))
+    drop = rng.random(len(ei)) < 0.3
+    A[ei[drop], ej[drop]] = A[ej[drop], ei[drop]] = 0.0
+    x = rng.standard_normal((n, 7))
+    x[[1, 5]] *= 1e4  # wild rows the screening must contain
+    nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+    live = _gather_live(A, nbr_idx, nbr_mask)
+    with enable_x64():
+        dense = make_robust_aggregator(rule, budget=1)
+        gather = make_gather_robust_aggregator(rule, 1, nbr_idx)
+        d_out = np.asarray(
+            dense(jnp.asarray(A, jnp.float64), jnp.asarray(x, jnp.float64))
+        )
+        g_out = np.asarray(
+            gather(
+                jnp.asarray(live, jnp.float64), jnp.asarray(x, jnp.float64)
+            )
+        )
+    o_out = robust_aggregate_np(rule, A, x, budget=1)
+    # ≤ 1e-12 in BOTH senses (the wild rows sit at 1e4, where a pure atol
+    # would demand better-than-ulp agreement).
+    np.testing.assert_allclose(g_out, d_out, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(g_out, o_out, rtol=1e-12, atol=1e-12)
+
+
+def test_gather_fixed_clip_tau_matches_dense():
+    topo = build_topology("erdos_renyi", 12, erdos_renyi_p=0.6, seed=9)
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((12, 5))
+    nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+    live = _gather_live(topo.adjacency, nbr_idx, nbr_mask)
+    with enable_x64():
+        dense = make_robust_aggregator("clipped_gossip", 1, clip_tau=0.7)
+        gather = make_gather_robust_aggregator(
+            "clipped_gossip", 1, nbr_idx, clip_tau=0.7
+        )
+        d_out = np.asarray(
+            dense(
+                jnp.asarray(topo.adjacency, jnp.float64),
+                jnp.asarray(x, jnp.float64),
+            )
+        )
+        g_out = np.asarray(
+            gather(
+                jnp.asarray(live, jnp.float64), jnp.asarray(x, jnp.float64)
+            )
+        )
+    np.testing.assert_allclose(g_out, d_out, rtol=0, atol=1e-12)
+    o_out = robust_aggregate_np(
+        "clipped_gossip", np.asarray(topo.adjacency), x, 1, clip_tau=0.7
+    )
+    np.testing.assert_allclose(g_out, o_out, rtol=0, atol=1e-12)
+
+
+# ------------------------------------ liveness == realized adjacency, per t
+
+@pytest.mark.parametrize(
+    "fault_kw",
+    [
+        dict(drop_prob=0.3),
+        dict(drop_prob=0.0, straggler_prob=0.25),
+        dict(drop_prob=0.3, straggler_prob=0.2),
+        dict(drop_prob=0.3, burst_len=4.0, horizon=12),
+        dict(drop_prob=0.25, burst_len=3.0, mttf=4.0, mttr=3.0, horizon=12),
+    ],
+    ids=["iid_edges", "stragglers", "edges+stragglers", "bursty", "composed"],
+)
+def test_neighbor_liveness_is_gathered_realized_adjacency(fault_kw):
+    """The gather-form fault realization consumes the SAME draws/chains as
+    the dense one: live(t) must equal realized_adjacency(t) gathered per
+    slot, bit for bit, at every iteration — memoryless and timeline paths."""
+    topo = build_topology("erdos_renyi", 10, erdos_renyi_p=0.5, seed=2)
+    faulty = make_faulty_mixing(topo, seed=5, **fault_kw)
+    nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+    live_fn = faulty.make_neighbor_liveness(nbr_idx, nbr_mask)
+    for t in range(fault_kw.get("horizon", 8)):
+        A_t = np.asarray(faulty.realized_adjacency(jnp.asarray(t)))
+        want = _gather_live(A_t, nbr_idx, nbr_mask)
+        got = np.asarray(live_fn(jnp.asarray(t)))
+        np.testing.assert_array_equal(got, want)
+
+
+# --------------------------------- identity-row degradation at the boundary
+
+@pytest.mark.parametrize("rule", RULES)
+def test_faulted_down_neighborhood_degrades_to_identity_row(rule):
+    """When faults shrink a realized closed neighborhood to ≤ 2b (or
+    deg ≤ b for adaptive clipping), that node keeps its own model — the
+    FaultyMixing isolated-node convention — in the gather form, the dense
+    form, and the oracle alike; full-degree rows still screen normally."""
+    topo = build_topology("ring", 10)  # k_max = 2, budget 1
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((10, 4))
+    A = np.array(topo.adjacency, copy=True)
+    A[0, :] = A[:, 0] = 0.0           # node 0 fully isolated
+    A[3, 4] = A[4, 3] = 0.0           # nodes 3/4 at degree 1 (= b)
+    nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+    live = _gather_live(A, nbr_idx, nbr_mask)
+    with enable_x64():
+        gather = make_gather_robust_aggregator(rule, 1, nbr_idx)
+        g_out = np.asarray(
+            gather(
+                jnp.asarray(live, jnp.float64), jnp.asarray(x, jnp.float64)
+            )
+        )
+        dense = make_robust_aggregator(rule, budget=1)
+        d_out = np.asarray(
+            dense(jnp.asarray(A, jnp.float64), jnp.asarray(x, jnp.float64))
+        )
+    o_out = robust_aggregate_np(rule, A, x, budget=1)
+    # Isolated node: identity row in every implementation.
+    for out in (g_out, d_out, o_out):
+        np.testing.assert_array_equal(out[0], x[0])
+    if rule == "trimmed_mean":
+        # degree 1 ⇒ closed count 2 ≤ 2b: identity row too.
+        for out in (g_out, d_out, o_out):
+            np.testing.assert_array_equal(out[3], x[3])
+    if rule == "clipped_gossip":
+        # degree 1 = b ⇒ adaptive τ = 0: the node does not move.
+        for out in (g_out, d_out, o_out):
+            np.testing.assert_allclose(out[3], x[3], rtol=0, atol=1e-15)
+    # A full-degree node still screens (not frozen by the degradation).
+    np.testing.assert_allclose(g_out, d_out, rtol=0, atol=1e-12)
+    np.testing.assert_allclose(g_out, o_out, rtol=0, atol=1e-12)
+
+
+# --------------------------------------------- end-to-end impl equivalence
+
+E2E_CFG = ExperimentConfig(
+    n_workers=12, n_samples=360, n_features=8, n_informative_features=5,
+    n_iterations=80, local_batch_size=8, problem_type="quadratic",
+    algorithm="dsgd", topology="erdos_renyi", erdos_renyi_p=0.6,
+    eval_every=20, dtype="float64", partition="shuffled",
+    attack="sign_flip", n_byzantine=2, attack_scale=2.0,
+    aggregation="trimmed_mean", robust_b=1,
+)
+
+
+@pytest.fixture(scope="module")
+def e2e_data():
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+    from distributed_optimization_tpu.utils.oracle import (
+        compute_reference_optimum,
+    )
+
+    ds = generate_synthetic_dataset(E2E_CFG)
+    _, f_opt = compute_reference_optimum(ds, E2E_CFG.reg_param)
+    return ds, f_opt
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_e2e_gather_matches_dense_under_composed_faults(e2e_data, rule):
+    """The full composition — bursty links + crash-recovery churn +
+    Byzantine sign-flip — through real backend runs: robust_impl is an
+    execution knob, so gather and dense must produce the same f64
+    trajectory (≤ 1e-12), and both must track the numpy oracle."""
+    ds, f_opt = e2e_data
+    cfg = E2E_CFG.replace(
+        aggregation=rule, edge_drop_prob=0.2, burst_len=3.0,
+        mttf=8.0, mttr=3.0,
+    )
+    from conftest import batch_schedule
+
+    sched = batch_schedule(ds, cfg.n_iterations, cfg.local_batch_size)
+    rd = jax_backend.run(
+        cfg.replace(robust_impl="dense"), ds, f_opt, batch_schedule=sched
+    )
+    rg = jax_backend.run(
+        cfg.replace(robust_impl="gather"), ds, f_opt, batch_schedule=sched
+    )
+    np.testing.assert_allclose(
+        rg.final_models, rd.final_models, rtol=0, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        rg.history.objective, rd.history.objective, rtol=1e-12
+    )
+    rn = numpy_backend.run(cfg, ds, f_opt, batch_schedule=sched)
+    np.testing.assert_allclose(
+        rg.final_models, rn.final_models, rtol=1e-9, atol=1e-10
+    )
+
+
+def test_e2e_auto_routes_like_explicit_on_sparse_graph(e2e_data):
+    """On a ring (k_max=2 ≪ N) 'auto' must take the gather path — same
+    compiled trajectory as forcing it."""
+    ds, f_opt = e2e_data
+    cfg = E2E_CFG.replace(topology="ring")
+    ra = jax_backend.run(cfg, ds, f_opt)
+    rg = jax_backend.run(cfg.replace(robust_impl="gather"), ds, f_opt)
+    np.testing.assert_array_equal(ra.final_models, rg.final_models)
+
+
+def test_gather_resume_exactness(e2e_data, tmp_path):
+    """Killed-and-resumed gather run == uninterrupted run: the neighbor
+    table is static and the liveness derives from (seed, t), so resume
+    rebuilds the identical screened trajectory."""
+    from distributed_optimization_tpu.utils.checkpoint import (
+        CheckpointOptions,
+    )
+
+    ds, f_opt = e2e_data
+    cfg = E2E_CFG.replace(
+        robust_impl="gather", edge_drop_prob=0.2, burst_len=2.0,
+        n_iterations=120, eval_every=20,
+    )
+    full = jax_backend.run(cfg, ds, f_opt)
+    ckdir = str(tmp_path / "gather_ck")
+    jax_backend.run(
+        cfg.replace(n_iterations=60), ds, f_opt,
+        checkpoint=CheckpointOptions(ckdir, every_evals=3),
+    )
+    resumed = jax_backend.run(
+        cfg, ds, f_opt, checkpoint=CheckpointOptions(ckdir, every_evals=3)
+    )
+    np.testing.assert_allclose(
+        resumed.final_models, full.final_models, rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        resumed.history.objective, full.history.objective, rtol=1e-12
+    )
+
+
+# ------------------------------------------------------- config / routing
+
+def test_config_rejects_bad_robust_impl():
+    with pytest.raises(ValueError, match="Unknown robust impl"):
+        ExperimentConfig(robust_impl="csr")
+    # An impl choice with no robust rule active would be silently ignored.
+    with pytest.raises(ValueError, match="silently ignored"):
+        ExperimentConfig(robust_impl="gather")
+    with pytest.raises(ValueError, match="silently ignored"):
+        ExperimentConfig(
+            robust_impl="dense", aggregation="median", robust_b=0
+        )
+
+
+def test_resolved_robust_impl_crossover():
+    cfg = ExperimentConfig(
+        n_workers=256, topology="ring", aggregation="trimmed_mean",
+        robust_b=1,
+    )
+    assert cfg.resolved_robust_impl(k_max=2) == "gather"
+    # Fully connected: k_max = N − 1, gather measured a tie at best —
+    # dense keeps the simpler form.
+    assert cfg.resolved_robust_impl(k_max=255) == "dense"
+    assert cfg.resolved_robust_impl(k_max=254) == "gather"
+    # Explicit choices pass through.
+    assert cfg.replace(robust_impl="dense").resolved_robust_impl(2) == "dense"
+    assert (
+        cfg.replace(robust_impl="gather").resolved_robust_impl(255)
+        == "gather"
+    )
+
+
+def test_cli_robust_impl_flag():
+    from distributed_optimization_tpu.cli import (
+        build_parser,
+        config_from_args,
+    )
+
+    args = build_parser().parse_args(
+        ["--aggregation", "median", "--robust-b", "1",
+         "--robust-impl", "gather"]
+    )
+    assert config_from_args(args).robust_impl == "gather"

@@ -146,19 +146,19 @@ def test_default_is_fused_at_every_cadence(data):
     assert np.all(np.isfinite(prime.history.objective))
 
 
-def test_hoisted_form_evals_exactly_on_cadence(data, monkeypatch):
+def test_hoisted_form_evals_exactly_on_cadence(data):
     """Round 5 (VERDICT r4 item 6): for eval-dominated coarse-cadence runs
     the fused path runs the HOISTED form — eval-free flat scans with the
     eval between them — paying the eval exactly once per cadence point.
-    Forced here via the measured gate (small test datasets are never
-    eval-dominated); trajectory must match the fine-cadence inline form at
-    shared eval points to fp exactness (same step sequence, f64)."""
+    Forced here via run()'s per-run gate kwarg (small test datasets are
+    never eval-dominated); trajectory must match the fine-cadence inline
+    form at shared eval points to fp exactness (same step sequence, f64)."""
     ds, f_opt = data
-    monkeypatch.setattr(jax_backend, "HOISTED_MIN_RATIO", 0.0)
     coarse = CFG.replace(n_iterations=64, eval_every=16, scan_unroll=4,
                          dtype="float64")
     fine = coarse.replace(eval_every=1)
-    rc = jax_backend.run(coarse, ds, f_opt)   # micro=4 -> hoisted
+    rc = jax_backend.run(coarse, ds, f_opt,
+                         hoisted_min_ratio=0.0)   # micro=4 -> hoisted
     rf = jax_backend.run(fine, ds, f_opt)     # micro=1 -> inline-on-cadence
     assert rc.history.objective.shape == (4,)
     np.testing.assert_allclose(
@@ -167,24 +167,25 @@ def test_hoisted_form_evals_exactly_on_cadence(data, monkeypatch):
     np.testing.assert_allclose(rc.final_models, rf.final_models, rtol=1e-12)
 
 
-def test_hoisted_checkpoint_segments_resume_exactly(data, tmp_path,
-                                                    monkeypatch):
-    """Checkpointed coarse-cadence runs hoist per segment (gate forced);
-    interrupting and resuming must reproduce the uninterrupted trajectory
-    bit-for-bit (the counter-based RNG + traced-offset design)."""
+def test_hoisted_checkpoint_segments_resume_exactly(data, tmp_path):
+    """Checkpointed coarse-cadence runs hoist per segment (gate forced via
+    the per-run kwarg); interrupting and resuming must reproduce the
+    uninterrupted trajectory bit-for-bit (the counter-based RNG +
+    traced-offset design)."""
     ds, f_opt = data
-    monkeypatch.setattr(jax_backend, "HOISTED_MIN_RATIO", 0.0)
     cfg = CFG.replace(n_iterations=80, eval_every=20, scan_unroll=4,
                       dtype="float64")
-    full = jax_backend.run(cfg, ds, f_opt)
+    full = jax_backend.run(cfg, ds, f_opt, hoisted_min_ratio=0.0)
     opts = CheckpointOptions(directory=str(tmp_path / "ck"), every_evals=2)
     first = jax_backend.run(
-        cfg.replace(n_iterations=40), ds, f_opt, checkpoint=opts
+        cfg.replace(n_iterations=40), ds, f_opt, checkpoint=opts,
+        hoisted_min_ratio=0.0,
     )
     resumed = jax_backend.run(
         cfg, ds, f_opt,
         checkpoint=CheckpointOptions(directory=str(tmp_path / "ck"),
                                      every_evals=2, resume=True),
+        hoisted_min_ratio=0.0,
     )
     np.testing.assert_allclose(resumed.final_models, full.final_models,
                                rtol=1e-12)
