@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test smoke bench-byzantine bench-churn bench-robust-scale
+.PHONY: test smoke bench-byzantine bench-churn bench-robust-scale \
+	bench-sweep bench-compute
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -11,12 +12,12 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
 # Fast robustness smoke: fault-injection + churn + Byzantine + gather-
-# aggregation suites, first failure stops, strict collection (no marker
-# typos, no swallowed import errors).
+# aggregation + replica-batched-parity suites, first failure stops,
+# strict collection (no marker typos, no swallowed import errors).
 smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest -q -m 'not slow' -x \
 		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py \
-		tests/test_robust_gather.py
+		tests/test_robust_gather.py tests/test_batch.py
 
 # Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
 bench-byzantine:
@@ -31,3 +32,16 @@ bench-churn:
 # at N=256 ring + crossover cells behind the robust_impl auto gate).
 bench-robust-scale:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_robust_scale.py
+
+# Regenerate the replica-batched sweep-throughput evidence
+# (docs/perf/sweep.json: run_batch aggregate vs sequential baseline per
+# R, asserted regime-dependent floor — 8x at R=32 on accelerators, 2.5x
+# steady on CPU hosts).
+bench-sweep:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_sweep.py
+
+# Regenerate the compute-bound tier evidence with its published MFU-floor
+# gate (docs/perf/compute_bound.json; meaningful numbers need the real
+# chip — on CPU containers set BENCH_NO_RANGE_CHECK=1).
+bench-compute:
+	$(PY) examples/bench_compute_bound.py

@@ -182,7 +182,7 @@ def run(
 
         topo = build_topology(
             config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
-            seed=config.seed,
+            seed=config.resolved_topology_seed(),
         )
         W = np.ascontiguousarray(topo.mixing_matrix, dtype=np.float64)
         algo = get_algorithm(config.algorithm)

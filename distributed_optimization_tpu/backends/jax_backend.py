@@ -20,6 +20,7 @@ loops end to end.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Optional
 
@@ -111,12 +112,371 @@ def _fetch_to_host(tree):
     return jax.tree.map(np.asarray, tree)
 
 
-def _make_eta_fn(config):
-    eta0 = config.learning_rate_eta0
+def _make_eta_fn(config, eta0=None):
+    """LR schedule closure; ``eta0`` overrides the config scalar — the
+    replica-batched path passes a per-replica traced value (a swept axis)."""
+    if eta0 is None:
+        eta0 = config.learning_rate_eta0
     if config.resolved_lr_schedule() == "sqrt_decay":
         # Parity: reference trainer.py:17-19, eta0 / sqrt(t + 1).
         return lambda t: eta0 / jnp.sqrt(t + 1.0)
     return lambda t: jnp.asarray(eta0)
+
+
+@dataclasses.dataclass(frozen=True)
+class _StepPieces:
+    """Everything the per-iteration step/eval closures bind to.
+
+    One bundle serves BOTH execution paths: ``_run`` fills it from the
+    config's own seed-derived randomness (concrete arrays), and
+    ``run_batch`` fills it per replica inside the vmapped trace (leaves
+    may be tracers carrying the replica axis) — so a batched replica runs
+    the IDENTICAL program as a sequential run, just under ``vmap``.
+    """
+
+    algo: object
+    problem: object
+    reg: float
+    config: object
+    batch_size: int
+    sampling_impl: str
+    key: object          # per-run sampling PRNG key
+    eta_fn: object
+    degrees: object
+    mix_op: object       # MixingOp or None (centralized)
+    faulty: object       # FaultyMixing or None
+    byz_mix: object      # composed Byzantine mix or None
+    adversary: object    # Adversary or None
+    honest_w: object     # [N] f32 honest mask or None
+    fused_mix_step: object
+    full_objective: object
+    f_opt: float
+    collect_metrics: bool
+    track_consensus: bool
+    edge_payload: object
+
+
+def _make_step_eval(p: _StepPieces, data):
+    """Bind the step/eval/floats closures to the data pytree passed through
+    jit (shared by the sequential and replica-batched paths — see
+    ``_StepPieces``)."""
+    X, y, n_valid = data["X"], data["y"], data["n_valid"]
+    schedule = data.get("schedule")
+    batch_size = p.batch_size
+    faulty, mix_op, byz_mix, adversary = (
+        p.faulty, p.mix_op, p.byz_mix, p.adversary
+    )
+
+    # Full-batch fast path: sampling b >= L rows without replacement IS
+    # the whole shard with 1/n_i weights (the reference's b=min(b, n_i)
+    # semantics, worker.py:21), so skip the per-iteration RNG + top_k +
+    # gather entirely — in the compute-bound tier the gather alone would
+    # otherwise copy the full [N, L, d] every iteration, doubling HBM
+    # traffic for no semantic effect.
+    full_batch = schedule is None and batch_size >= X.shape[1]
+    if full_batch:
+        Lr = X.shape[1]
+        fmask = (
+            jnp.arange(Lr)[None, :] < n_valid[:, None]
+        ).astype(X.dtype)
+        full_wts = fmask / jnp.maximum(
+            n_valid[:, None].astype(X.dtype), 1.0
+        )
+
+    def grad_fn_factory(t):
+        def grad(params, slot):
+            if schedule is not None:
+                idx = schedule[t]  # [N, b] injected batch indices
+                Xb = jnp.take_along_axis(X, idx[:, :, None], axis=1)
+                yb = jnp.take_along_axis(y, idx, axis=1)
+                wts = jnp.full(idx.shape, 1.0 / idx.shape[1], dtype=X.dtype)
+            elif full_batch:
+                Xb, yb, wts = X, y, full_wts
+            elif p.sampling_impl == "dense":
+                # Dense-weights sampling: no top_k, no gather — the
+                # weighted gradient runs over the full padded shard with
+                # 1/b weights on the sampled rows (same subsets as the
+                # gather path for the same key; see ops/sampling.py).
+                slot_key = jax.random.fold_in(p.key, slot)
+                Xb, yb = X, y
+                wts = sample_worker_batch_weights(
+                    slot_key, t, n_valid, X.shape[1], batch_size
+                ).astype(X.dtype)
+            else:
+                slot_key = jax.random.fold_in(p.key, slot)
+                Xb, yb, wts = sample_worker_batches(
+                    slot_key, t, X, y, n_valid, batch_size
+                )
+                wts = wts.astype(X.dtype)  # keep bf16 carries unpromoted
+            return jax.vmap(
+                p.problem.gradient_weighted, in_axes=(0, 0, 0, 0, None)
+            )(params, Xb, yb, wts, p.reg)
+
+        return grad
+
+    def step(state, t):
+        if faulty is not None and faulty.rejoin_restart is not None:
+            # neighbor_restart rejoin policy: BEFORE the step at the
+            # rejoin round, a node coming back from an outage replaces
+            # its stale model row with the realized-neighborhood
+            # average (auxiliary leaves stay frozen-stale — only the
+            # model is warm-restarted). The restarted value is what it
+            # gossips this round.
+            state = {
+                **state, "x": faulty.rejoin_restart(t, state["x"])
+            }
+        if faulty is not None:
+            mix_fn = lambda v: faulty.mix(t, v)  # noqa: E731
+            nbr_fn = lambda v: faulty.neighbor_sum(t, v)  # noqa: E731
+        elif mix_op is not None:
+            mix_fn, nbr_fn = mix_op.apply, mix_op.neighbor_sum
+        else:
+            mix_fn, nbr_fn = (lambda v: v), (lambda v: v * 0)
+        if byz_mix is not None:
+            # Corrupt outgoing models, then (robustly) aggregate — the
+            # composed per-iteration mix from parallel/adversary.py.
+            # neighbor_sum sees the corrupted stack too (consistency;
+            # no byzantine-supported algorithm consumes it today).
+            base_nbr = nbr_fn
+            mix_fn = lambda v: byz_mix(t, v)  # noqa: E731
+            if adversary is not None:
+                nbr_fn = lambda v: base_nbr(  # noqa: E731
+                    adversary.corrupt(t, v)
+                )
+        ctx = StepContext(
+            grad=grad_fn_factory(t),
+            mix=mix_fn,
+            neighbor_sum=nbr_fn,
+            # Cast to the run dtype so low-precision carries (bfloat16)
+            # aren't silently promoted by the f32 schedule scalar.
+            eta=p.eta_fn(t).astype(X.dtype),
+            t=t,
+            degrees=p.degrees,
+            config=p.config,
+            fused_mix_step=p.fused_mix_step,
+        )
+        new_state = p.algo.step(state, ctx)
+        if faulty is not None and (
+            faulty.straggler_prob > 0.0 or faulty.churn_active
+        ):
+            # A straggler/crashed node takes no step at all: freeze its
+            # rows across every state leaf (each leaf leads with the
+            # worker axis) — for churn, across the WHOLE outage, so a
+            # 'frozen' rejoin resumes the stale pre-crash state for
+            # free. Its mixing row already degenerated to identity via
+            # the dropped edges.
+            m = faulty.active(t)
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(
+                    m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old
+                ),
+                new_state,
+                state,
+            )
+        return new_state, None
+
+    def eval_metrics(state):
+        out = {}
+        if p.collect_metrics:
+            x = state["x"]
+            if adversary is not None:
+                # Honest-only metrics (docs/BYZANTINE.md): the gap is
+                # f(x̄_honest) − f* on the unchanged global objective,
+                # consensus is the honest spread — Byzantine rows are
+                # adversary-controlled and would poison both.
+                hw = p.honest_w.astype(x.dtype)
+                nh = jnp.sum(hw)
+                xbar = jnp.sum(x * hw[:, None], axis=0) / nh
+                out["gap"] = p.full_objective(xbar, X, y, n_valid) - p.f_opt
+                if p.track_consensus:
+                    out["cons"] = (
+                        jnp.sum(
+                            hw * jnp.sum((x - xbar[None, :]) ** 2, axis=1)
+                        )
+                        / nh
+                    )
+            else:
+                xbar = jnp.mean(x, axis=0)
+                out["gap"] = p.full_objective(xbar, X, y, n_valid) - p.f_opt
+                if p.track_consensus:
+                    out["cons"] = jnp.mean(
+                        jnp.sum((x - xbar[None, :]) ** 2, axis=1)
+                    )
+        return out
+
+    def floats_for(ts):
+        # Honest comms accounting under faults: floats actually
+        # exchanged over realized edges for these iterations (recomputed
+        # from the fault keys, so it costs one tiny mask redraw per
+        # iteration, no extra communication).
+        return (
+            jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts))
+            * p.edge_payload
+        )
+
+    return step, eval_metrics, floats_for
+
+
+def _flat_scan_cadence(scan_unroll: int, eval_every: int):
+    """(micro, trips_per_eval, flat_unroll) for the flat fused scan.
+
+    ``micro`` is the largest divisor of ``eval_every`` within the unroll
+    budget, so some scan trip lands exactly on every eval boundary. One
+    derivation shared by the sequential and replica-batched paths — their
+    eval cadence must not be able to drift apart.
+    """
+    micro = next(
+        d for d in range(min(scan_unroll, eval_every), 0, -1)
+        if eval_every % d == 0
+    )
+    return micro, eval_every // micro, max(1, scan_unroll // micro)
+
+
+def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
+                  timeline=None, horizon=None):
+    """Time-varying gossip wiring shared by ``_run`` and ``run_batch``.
+
+    Returns a ``FaultyMixing`` (or None for a static graph) after the
+    algorithm-support validation. The keyword overrides are the replica-
+    batched hooks: ``drop_prob`` a per-replica (possibly traced) scalar,
+    ``keys`` pre-derived per-replica PRNG keys, ``timeline`` a prebuilt
+    per-replica ``FaultTimeline`` view, ``horizon`` the timeline length
+    (t0 + T for continued batches; defaults to T).
+    """
+    time_varying = (
+        config.edge_drop_prob > 0.0
+        or config.straggler_prob > 0.0
+        or config.mttf > 0.0
+        or config.gossip_schedule != "synchronous"
+        or drop_prob is not None
+    )
+    if not time_varying:
+        return None
+    if not algo.supports_edge_faults:
+        raise ValueError(
+            f"time-varying gossip is unsupported for {algo.name!r}: "
+            "the step rule is not faithful under per-iteration "
+            "graphs (ADMM pairs neighbor sums with static degrees; "
+            "CHOCO's shared estimate state cannot represent "
+            "undelivered updates; EXTRA's fixed-point argument "
+            "requires a static W)"
+        )
+    if config.mttf > 0.0 and not algo.supports_churn:
+        raise ValueError(
+            f"crash-recovery churn is unsupported for {algo.name!r}: "
+            "multi-round outages freeze a node's whole state and "
+            "may warm-restart its model on rejoin, which only "
+            "mix-based rules tolerate (push-sum's (num, w) mass "
+            "pair cannot be restarted consistently; EXTRA/ADMM/"
+            "CHOCO already reject time-varying graphs) — use "
+            "'dsgd' or 'gradient_tracking'"
+        )
+    if config.gossip_schedule == "round_robin":
+        return make_round_robin_mixing(topo)
+    return make_faulty_mixing(
+        topo,
+        config.edge_drop_prob if drop_prob is None else drop_prob,
+        config.seed,
+        straggler_prob=config.straggler_prob,
+        one_peer=config.gossip_schedule == "one_peer",
+        burst_len=config.burst_len,
+        mttf=config.mttf, mttr=config.mttr,
+        rejoin=config.rejoin,
+        horizon=T if horizon is None else horizon,
+        keys=keys, timeline=timeline,
+    )
+
+
+def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
+                    byz=None, noise_key=None):
+    """Byzantine adversary + robust-aggregation wiring shared by ``_run``
+    and ``run_batch`` (docs/BYZANTINE.md). Returns ``(adversary,
+    byz_mix)`` — both None when the config is benign. The keyword
+    overrides are the replica-batched hooks: ``clip_tau`` a per-replica
+    (possibly traced) radius, ``byz``/``noise_key`` the per-replica
+    Byzantine set and large-noise stream.
+    """
+    byzantine_active = config.attack != "none" or (
+        config.aggregation != "gossip" and config.robust_b > 0
+    )
+    if not byzantine_active:
+        return None, None
+    if not algo.supports_byzantine:
+        raise ValueError(
+            f"Byzantine injection / robust aggregation is "
+            f"unsupported for {algo.name!r}: only step rules whose "
+            "updates go through the gossip mix alone compose with "
+            "screened aggregation (EXTRA's fixed point needs the "
+            "static linear W; ADMM pairs neighbor sums with static "
+            "degrees; CHOCO's shared estimates cannot represent "
+            "screened-out updates; push-sum's debiasing needs the "
+            "column-stochastic mass conservation screening breaks) "
+            "— use 'dsgd' or 'gradient_tracking'"
+        )
+    adversary = make_adversary(
+        config.n_workers, config.attack, config.n_byzantine,
+        config.attack_scale, config.seed, byz=byz, noise_key=noise_key,
+    )
+    robust_aggregate_t = None
+    if config.aggregation != "gossip" and config.robust_b > 0:
+        validate_budget(
+            int(topo.degrees.min()), config.robust_b,
+            config.aggregation,
+        )
+        ct = config.clip_tau if clip_tau is None else clip_tau
+        # The screened-rule execution form (docs/BYZANTINE.md
+        # "Degree-bounded gather path"): 'gather' screens over the
+        # static [N, k_max] neighbor table — O(N·k_max·d·log k_max)
+        # — instead of the dense [N, N, d] node-axis sort; 'auto'
+        # routes by the measured crossover (resolved_robust_impl).
+        # Both forms bind the rule to the SAME per-iteration fault
+        # realization, in dense-adjacency or gathered-slot form.
+        robust_impl = config.resolved_robust_impl(
+            int(topo.degrees.max())
+        )
+        if robust_impl == "gather":
+            from distributed_optimization_tpu.parallel.topology import (
+                neighbor_table,
+            )
+
+            nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+            gather_agg = make_gather_robust_aggregator(
+                config.aggregation, config.robust_b, nbr_idx, ct,
+            )
+            if faulty is not None:
+                live_fn = faulty.make_neighbor_liveness(
+                    nbr_idx, nbr_mask
+                )
+            else:
+                static_live = jnp.asarray(
+                    nbr_mask, dtype=jnp.float32
+                )
+                live_fn = lambda t: static_live  # noqa: E731
+            robust_aggregate_t = (
+                lambda t, v: gather_agg(live_fn(t), v)  # noqa: E731
+            )
+        else:
+            dense_agg = make_robust_aggregator(
+                config.aggregation, config.robust_b, ct
+            )
+            if faulty is not None:
+                adj_fn = faulty.realized_adjacency
+            else:
+                static_A = jnp.asarray(
+                    topo.adjacency, dtype=jnp.float32
+                )
+                adj_fn = lambda t: static_A  # noqa: E731
+            robust_aggregate_t = (
+                lambda t, v: dense_agg(adj_fn(t), v)  # noqa: E731
+            )
+    if faulty is not None:
+        base_mix_t = faulty.mix
+    else:
+        base_mix_t = lambda t, v: mix_op.apply(v)  # noqa: E731
+    byz_mix = make_byzantine_mixing(
+        adversary, base_mix_t, aggregate_t=robust_aggregate_t,
+    )
+    return adversary, byz_mix
 
 
 def _run_chunked(
@@ -518,7 +878,8 @@ def _run(
     # --- topology & collectives (centralized needs none) ---
     if algo.is_decentralized:
         topo = build_topology(
-            config.topology, n, erdos_renyi_p=config.erdos_renyi_p, seed=config.seed
+            config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
+            seed=config.resolved_topology_seed(),
         )
         if mesh is None and use_mesh and len(jax.devices()) > 1:
             # The shard_map grid stencil blocks grid ROWS over devices, so the
@@ -556,137 +917,33 @@ def _run(
             or config.mttf > 0.0
             or config.gossip_schedule != "synchronous"
         )
-        if time_varying:
-            if config.mixing_impl == "shard_map":
+        byzantine_active = config.attack != "none" or (
+            config.aggregation != "gossip" and config.robust_b > 0
+        )
+        if config.mixing_impl == "shard_map":
+            if time_varying:
                 raise ValueError(
                     "fault injection / matching-based gossip requires dense "
                     "or stencil mixing: the shard_map stencils assume the "
                     "static uniform-weight topology"
                 )
-            if not algo.supports_edge_faults:
-                raise ValueError(
-                    f"time-varying gossip is unsupported for {algo.name!r}: "
-                    "the step rule is not faithful under per-iteration "
-                    "graphs (ADMM pairs neighbor sums with static degrees; "
-                    "CHOCO's shared estimate state cannot represent "
-                    "undelivered updates; EXTRA's fixed-point argument "
-                    "requires a static W)"
-                )
-            if config.mttf > 0.0 and not algo.supports_churn:
-                raise ValueError(
-                    f"crash-recovery churn is unsupported for {algo.name!r}: "
-                    "multi-round outages freeze a node's whole state and "
-                    "may warm-restart its model on rejoin, which only "
-                    "mix-based rules tolerate (push-sum's (num, w) mass "
-                    "pair cannot be restarted consistently; EXTRA/ADMM/"
-                    "CHOCO already reject time-varying graphs) — use "
-                    "'dsgd' or 'gradient_tracking'"
-                )
-            if config.gossip_schedule == "round_robin":
-                faulty = make_round_robin_mixing(topo)
-            else:
-                faulty = make_faulty_mixing(
-                    topo, config.edge_drop_prob, config.seed,
-                    straggler_prob=config.straggler_prob,
-                    one_peer=config.gossip_schedule == "one_peer",
-                    burst_len=config.burst_len,
-                    mttf=config.mttf, mttr=config.mttr,
-                    rejoin=config.rejoin, horizon=T,
-                )
-        else:
-            faulty = None
-        # --- Byzantine adversary + robust aggregation (docs/BYZANTINE.md).
-        # Active when there is an attack to simulate OR a robust rule with
-        # a positive budget to defend with; robust_b == 0 keeps the plain
-        # gossip path bitwise (a robust rule degrades to MH gossip at zero
-        # budget by definition).
-        byzantine_active = config.attack != "none" or (
-            config.aggregation != "gossip" and config.robust_b > 0
-        )
-        adversary = None
-        byz_mix = None
-        if byzantine_active:
-            if not algo.supports_byzantine:
-                raise ValueError(
-                    f"Byzantine injection / robust aggregation is "
-                    f"unsupported for {algo.name!r}: only step rules whose "
-                    "updates go through the gossip mix alone compose with "
-                    "screened aggregation (EXTRA's fixed point needs the "
-                    "static linear W; ADMM pairs neighbor sums with static "
-                    "degrees; CHOCO's shared estimates cannot represent "
-                    "screened-out updates; push-sum's debiasing needs the "
-                    "column-stochastic mass conservation screening breaks) "
-                    "— use 'dsgd' or 'gradient_tracking'"
-                )
-            if config.mixing_impl == "shard_map":
+            if byzantine_active:
                 raise ValueError(
                     "Byzantine injection / robust aggregation requires "
                     "dense or stencil mixing: the shard_map stencils "
                     "assume the static uniform-weight benign topology"
                 )
-            adversary = make_adversary(
-                n, config.attack, config.n_byzantine, config.attack_scale,
-                config.seed,
-            )
-            robust_aggregate_t = None
-            if config.aggregation != "gossip" and config.robust_b > 0:
-                validate_budget(
-                    int(topo.degrees.min()), config.robust_b,
-                    config.aggregation,
-                )
-                # The screened-rule execution form (docs/BYZANTINE.md
-                # "Degree-bounded gather path"): 'gather' screens over the
-                # static [N, k_max] neighbor table — O(N·k_max·d·log k_max)
-                # — instead of the dense [N, N, d] node-axis sort; 'auto'
-                # routes by the measured crossover (resolved_robust_impl).
-                # Both forms bind the rule to the SAME per-iteration fault
-                # realization, in dense-adjacency or gathered-slot form.
-                robust_impl = config.resolved_robust_impl(
-                    int(topo.degrees.max())
-                )
-                if robust_impl == "gather":
-                    from distributed_optimization_tpu.parallel.topology import (
-                        neighbor_table,
-                    )
-
-                    nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
-                    gather_agg = make_gather_robust_aggregator(
-                        config.aggregation, config.robust_b, nbr_idx,
-                        config.clip_tau,
-                    )
-                    if faulty is not None:
-                        live_fn = faulty.make_neighbor_liveness(
-                            nbr_idx, nbr_mask
-                        )
-                    else:
-                        static_live = jnp.asarray(
-                            nbr_mask, dtype=jnp.float32
-                        )
-                        live_fn = lambda t: static_live  # noqa: E731
-                    robust_aggregate_t = (
-                        lambda t, v: gather_agg(live_fn(t), v)  # noqa: E731
-                    )
-                else:
-                    dense_agg = make_robust_aggregator(
-                        config.aggregation, config.robust_b, config.clip_tau
-                    )
-                    if faulty is not None:
-                        adj_fn = faulty.realized_adjacency
-                    else:
-                        static_A = jnp.asarray(
-                            topo.adjacency, dtype=jnp.float32
-                        )
-                        adj_fn = lambda t: static_A  # noqa: E731
-                    robust_aggregate_t = (
-                        lambda t, v: dense_agg(adj_fn(t), v)  # noqa: E731
-                    )
-            if faulty is not None:
-                base_mix_t = faulty.mix
-            else:
-                base_mix_t = lambda t, v: mix_op.apply(v)  # noqa: E731
-            byz_mix = make_byzantine_mixing(
-                adversary, base_mix_t, aggregate_t=robust_aggregate_t,
-            )
+        # Time-varying gossip and the Byzantine adversary + robust
+        # aggregation composition (docs/BYZANTINE.md) — wiring shared with
+        # the replica-batched path (``_build_faulty``/``_bind_byzantine``).
+        # Byzantine is active when there is an attack to simulate OR a
+        # robust rule with a positive budget to defend with; robust_b == 0
+        # keeps the plain gossip path bitwise (a robust rule degrades to
+        # MH gossip at zero budget by definition).
+        faulty = _build_faulty(config, algo, topo, T)
+        adversary, byz_mix = _bind_byzantine(
+            config, algo, topo, faulty, mix_op
+        )
     else:
         if (
             config.edge_drop_prob > 0.0
@@ -708,6 +965,7 @@ def _run(
         topo = None
         mix_op = None
         faulty = None
+        edge_payload = None
         degrees = jnp.zeros((n, 1), dtype=device_data.X.dtype)
         floats_per_iter = centralized_floats_per_iteration(n, d_model)
         spectral_gap = None
@@ -796,159 +1054,18 @@ def _run(
 
         fused_mix_step = fused_ring_dsgd_step
 
+    pieces = _StepPieces(
+        algo=algo, problem=problem, reg=reg, config=config,
+        batch_size=batch_size, sampling_impl=sampling_impl, key=key,
+        eta_fn=eta_fn, degrees=degrees, mix_op=mix_op, faulty=faulty,
+        byz_mix=byz_mix, adversary=adversary, honest_w=honest_w,
+        fused_mix_step=fused_mix_step, full_objective=full_objective,
+        f_opt=f_opt, collect_metrics=collect_metrics,
+        track_consensus=track_consensus, edge_payload=edge_payload,
+    )
+
     def make_step_eval(data):
-        """Bind the step/eval closures to the data pytree passed through jit."""
-        X, y, n_valid = data["X"], data["y"], data["n_valid"]
-        schedule = data.get("schedule")
-
-        # Full-batch fast path: sampling b >= L rows without replacement IS
-        # the whole shard with 1/n_i weights (the reference's b=min(b, n_i)
-        # semantics, worker.py:21), so skip the per-iteration RNG + top_k +
-        # gather entirely — in the compute-bound tier the gather alone would
-        # otherwise copy the full [N, L, d] every iteration, doubling HBM
-        # traffic for no semantic effect.
-        full_batch = schedule is None and batch_size >= X.shape[1]
-        if full_batch:
-            Lr = X.shape[1]
-            fmask = (
-                jnp.arange(Lr)[None, :] < n_valid[:, None]
-            ).astype(X.dtype)
-            full_wts = fmask / jnp.maximum(
-                n_valid[:, None].astype(X.dtype), 1.0
-            )
-
-        def grad_fn_factory(t):
-            def grad(params, slot):
-                if schedule is not None:
-                    idx = schedule[t]  # [N, b] injected batch indices
-                    Xb = jnp.take_along_axis(X, idx[:, :, None], axis=1)
-                    yb = jnp.take_along_axis(y, idx, axis=1)
-                    wts = jnp.full(idx.shape, 1.0 / idx.shape[1], dtype=X.dtype)
-                elif full_batch:
-                    Xb, yb, wts = X, y, full_wts
-                elif sampling_impl == "dense":
-                    # Dense-weights sampling: no top_k, no gather — the
-                    # weighted gradient runs over the full padded shard with
-                    # 1/b weights on the sampled rows (same subsets as the
-                    # gather path for the same key; see ops/sampling.py).
-                    slot_key = jax.random.fold_in(key, slot)
-                    Xb, yb = X, y
-                    wts = sample_worker_batch_weights(
-                        slot_key, t, n_valid, X.shape[1], batch_size
-                    ).astype(X.dtype)
-                else:
-                    slot_key = jax.random.fold_in(key, slot)
-                    Xb, yb, wts = sample_worker_batches(
-                        slot_key, t, X, y, n_valid, batch_size
-                    )
-                    wts = wts.astype(X.dtype)  # keep bf16 carries unpromoted
-                return jax.vmap(
-                    problem.gradient_weighted, in_axes=(0, 0, 0, 0, None)
-                )(params, Xb, yb, wts, reg)
-
-            return grad
-
-        def step(state, t):
-            if faulty is not None and faulty.rejoin_restart is not None:
-                # neighbor_restart rejoin policy: BEFORE the step at the
-                # rejoin round, a node coming back from an outage replaces
-                # its stale model row with the realized-neighborhood
-                # average (auxiliary leaves stay frozen-stale — only the
-                # model is warm-restarted). The restarted value is what it
-                # gossips this round.
-                state = {
-                    **state, "x": faulty.rejoin_restart(t, state["x"])
-                }
-            if faulty is not None:
-                mix_fn = lambda v: faulty.mix(t, v)  # noqa: E731
-                nbr_fn = lambda v: faulty.neighbor_sum(t, v)  # noqa: E731
-            elif mix_op is not None:
-                mix_fn, nbr_fn = mix_op.apply, mix_op.neighbor_sum
-            else:
-                mix_fn, nbr_fn = (lambda v: v), (lambda v: v * 0)
-            if byz_mix is not None:
-                # Corrupt outgoing models, then (robustly) aggregate — the
-                # composed per-iteration mix from parallel/adversary.py.
-                # neighbor_sum sees the corrupted stack too (consistency;
-                # no byzantine-supported algorithm consumes it today).
-                base_nbr = nbr_fn
-                mix_fn = lambda v: byz_mix(t, v)  # noqa: E731
-                if adversary is not None:
-                    nbr_fn = lambda v: base_nbr(  # noqa: E731
-                        adversary.corrupt(t, v)
-                    )
-            ctx = StepContext(
-                grad=grad_fn_factory(t),
-                mix=mix_fn,
-                neighbor_sum=nbr_fn,
-                # Cast to the run dtype so low-precision carries (bfloat16)
-                # aren't silently promoted by the f32 schedule scalar.
-                eta=eta_fn(t).astype(X.dtype),
-                t=t,
-                degrees=degrees,
-                config=config,
-                fused_mix_step=fused_mix_step,
-            )
-            new_state = algo.step(state, ctx)
-            if faulty is not None and (
-                faulty.straggler_prob > 0.0 or faulty.churn_active
-            ):
-                # A straggler/crashed node takes no step at all: freeze its
-                # rows across every state leaf (each leaf leads with the
-                # worker axis) — for churn, across the WHOLE outage, so a
-                # 'frozen' rejoin resumes the stale pre-crash state for
-                # free. Its mixing row already degenerated to identity via
-                # the dropped edges.
-                m = faulty.active(t)
-                new_state = jax.tree.map(
-                    lambda new, old: jnp.where(
-                        m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old
-                    ),
-                    new_state,
-                    state,
-                )
-            return new_state, None
-
-        def eval_metrics(state):
-            out = {}
-            if collect_metrics:
-                x = state["x"]
-                if adversary is not None:
-                    # Honest-only metrics (docs/BYZANTINE.md): the gap is
-                    # f(x̄_honest) − f* on the unchanged global objective,
-                    # consensus is the honest spread — Byzantine rows are
-                    # adversary-controlled and would poison both.
-                    hw = honest_w.astype(x.dtype)
-                    nh = jnp.sum(hw)
-                    xbar = jnp.sum(x * hw[:, None], axis=0) / nh
-                    out["gap"] = full_objective(xbar, X, y, n_valid) - f_opt
-                    if track_consensus:
-                        out["cons"] = (
-                            jnp.sum(
-                                hw * jnp.sum((x - xbar[None, :]) ** 2, axis=1)
-                            )
-                            / nh
-                        )
-                else:
-                    xbar = jnp.mean(x, axis=0)
-                    out["gap"] = full_objective(xbar, X, y, n_valid) - f_opt
-                    if track_consensus:
-                        out["cons"] = jnp.mean(
-                            jnp.sum((x - xbar[None, :]) ** 2, axis=1)
-                        )
-            return out
-
-        def floats_for(ts):
-            # Honest comms accounting under faults: floats actually
-            # exchanged over realized edges for these iterations (recomputed
-            # from the fault keys, so it costs one tiny mask redraw per
-            # iteration, no extra communication).
-            return (
-                jnp.sum(jax.vmap(faulty.realized_degree_sum)(ts))
-                * edge_payload
-            )
-
-        return step, eval_metrics, floats_for
+        return _make_step_eval(pieces, data)
 
     def make_chunk(data):
         """One eval-chunk for the host-driven loop: ``eval_every`` iterations
@@ -983,9 +1100,8 @@ def _run(
     # per SEGMENT (each compiled scan covers every_evals eval-chunks), so
     # the hoist-availability gate uses the per-scan eval count, not the
     # run total.
-    _micro_probe = next(
-        d for d in range(min(scan_unroll, eval_every), 0, -1)
-        if eval_every % d == 0
+    _micro_probe, _trips_per_eval, _flat_unroll = _flat_scan_cadence(
+        scan_unroll, eval_every
     )
     per_scan_evals = (
         n_evals if checkpoint is None
@@ -1023,8 +1139,8 @@ def _run(
         # chunk loop's 2.2× coarse-cadence tax for the whole run; the host
         # intervenes once per SAVE, not once per eval.
         micro = _micro_probe
-        trips_per_eval = eval_every // micro
-        flat_unroll = max(1, scan_unroll // micro)
+        trips_per_eval = _trips_per_eval
+        flat_unroll = _flat_unroll
 
         # Exact-cadence "hoisted" form (round 5 — VERDICT r4 item 6): a
         # Python-unrolled SEQUENCE of eval-free flat scans with the metric
@@ -1252,4 +1368,525 @@ def _run(
             if return_state
             else None
         ),
+    )
+
+
+# --------------------------------------------------------------------------
+# Replica-batched execution (ISSUE-4 tentpole): R independent runs — seed
+# replicates and/or swept scalar hyperparameters — as ONE vmapped compiled
+# program. The headline hot loop is latency/dispatch-bound (BENCH_r05: a
+# [256, 81] model stack at ~103k iters/sec leaves the vector lanes mostly
+# idle), so stacking R runs into [R, N, d] buys aggregate sweep throughput
+# for near-free: every seed replicate a suite row needs, and every
+# robustness experiment's mean ± std over fault realizations, costs ~one
+# run's wall-clock instead of R (measured: examples/bench_sweep.py →
+# docs/perf/sweep.json, asserted ≥ 8× aggregate at R=32).
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BatchRunResult:
+    """R replica trajectories from one ``run_batch`` call.
+
+    ``results[r]`` is a per-replica ``BackendRunResult`` whose history is
+    trajectory-equivalent to a sequential ``run`` of
+    ``config.replace(seed=seeds[r], **{f: sweep[f][r]})`` (pinned ≤ 1e-12
+    in f64 by tests/test_batch.py, fault and Byzantine layers included).
+    Per-replica ``iters_per_second`` is the aggregate divided by R (the
+    batch time-slices the chip evenly); ``aggregate_iters_per_second`` is
+    the batch's R·T / run_seconds — the sweep-throughput headline.
+    ``final_states`` holds the raw stacked state pytree ([R, ...] leaves,
+    run dtype) — pass it back as ``state0`` with ``t0`` advanced to
+    continue the batch exactly (per-replica resume-exactness is tested).
+    """
+
+    results: list
+    seeds: list
+    sweep: Optional[dict]
+    objective: np.ndarray  # [R, n_evals] suboptimality gaps
+    consensus_error: Optional[np.ndarray]  # [R, n_evals] or None
+    aggregate_iters_per_second: float
+    run_seconds: float
+    compile_seconds: float
+    final_states: dict
+
+
+def run_batch(
+    config,
+    dataset: HostDataset,
+    f_opt: float,
+    *,
+    seeds=None,
+    sweep=None,
+    collect_metrics: bool = True,
+    measure_compile: bool = True,
+    state0=None,
+    t0: int = 0,
+) -> BatchRunResult:
+    """Run R replicas of ``config`` as one vmapped XLA program.
+
+    ``seeds``: per-replica seed vector (default ``config.replica_seeds()``
+    — seed, seed+1, ..., seed+replicas−1). ``sweep``: optional dict
+    mapping a ``SWEEPABLE_FIELDS`` name to R per-replica values; replica r
+    then behaves exactly like a sequential run of ``config.replace(
+    seed=seeds[r], **{field: values[r]})``. ``state0``/``t0`` continue a
+    previous batch from its ``final_states`` (iteration indices — and the
+    counter-based sampling/fault draws with them — resume at t0, so the
+    continuation is exactly the one-shot program split in two).
+
+    Structural axes (topology, n_workers, algorithm, ...) cannot batch —
+    they change the traced program — and are rejected; so are the config
+    combinations whose execution cannot wrap in vmap (shard_map/pallas
+    mixing, tensor parallelism, choco's internal seed derivation). The
+    batched program runs unsharded (the replica axis fills the chip
+    instead of the worker mesh) and always uses the fused flat scan.
+    """
+    from distributed_optimization_tpu.backends.base import x64_scope
+
+    with x64_scope(config):
+        return _run_batch(
+            config, dataset, f_opt, seeds=seeds, sweep=sweep,
+            collect_metrics=collect_metrics,
+            measure_compile=measure_compile, state0=state0, t0=t0,
+        )
+
+
+def _run_batch(
+    config,
+    dataset: HostDataset,
+    f_opt: float,
+    *,
+    seeds,
+    sweep,
+    collect_metrics: bool,
+    measure_compile: bool,
+    state0,
+    t0: int,
+) -> BatchRunResult:
+    from distributed_optimization_tpu.config import SWEEPABLE_FIELDS
+    from distributed_optimization_tpu.parallel.adversary import (
+        _BYZ_NOISE_TAG,
+        byzantine_mask,
+    )
+    from distributed_optimization_tpu.parallel.faults import (
+        FaultTimeline,
+        build_fault_timeline,
+        stack_fault_timelines,
+    )
+
+    # --- resolve and validate the replica axis -------------------------
+    if seeds is None:
+        seeds = config.replica_seeds()
+    seeds = [int(s) for s in seeds]
+    if not seeds:
+        raise ValueError("run_batch needs at least one replica seed")
+    R = len(seeds)
+    sweep = {k: list(v) for k, v in (sweep or {}).items()}
+    for field, values in sweep.items():
+        if field not in SWEEPABLE_FIELDS:
+            raise ValueError(
+                f"cannot sweep {field!r} inside one batched program: only "
+                f"per-replica scalars that enter the compiled program as "
+                f"data batch this way ({', '.join(SWEEPABLE_FIELDS)}); "
+                "structural axes change the traced program itself — run "
+                "separate (possibly batched) calls per value"
+            )
+        if len(values) != R:
+            raise ValueError(
+                f"sweep[{field!r}] has {len(values)} values for {R} "
+                "replicas; every swept axis must match the seed vector's "
+                "length"
+            )
+    if config.algorithm == "choco":
+        raise ValueError(
+            "run_batch does not support 'choco': its step rule derives "
+            "the compressor stream from config.seed internally, which the "
+            "batched per-replica seed axis cannot reach — replicas would "
+            "silently share compression draws"
+        )
+    if config.mixing_impl in ("shard_map", "pallas"):
+        raise ValueError(
+            f"run_batch is incompatible with mixing_impl="
+            f"{config.mixing_impl!r}: shard_map stencils pin a device "
+            "mesh and the pallas kernels address unbatched VMEM blocks — "
+            "use 'auto', 'dense', 'stencil', or 'sparse'"
+        )
+    if config.tp_degree > 1:
+        raise ValueError(
+            "run_batch and tp_degree > 1 are mutually exclusive: the TP "
+            "path pins a 2-D (workers, model) device mesh that the "
+            "replica vmap axis cannot wrap"
+        )
+    if t0 < 0:
+        raise ValueError(f"t0 must be >= 0, got {t0}")
+    if not get_algorithm(config.algorithm).is_decentralized and (
+        config.edge_drop_prob > 0.0
+        or config.straggler_prob > 0.0
+        or config.mttf > 0.0
+        or config.gossip_schedule != "synchronous"
+        or config.attack != "none"
+        or (config.aggregation != "gossip" and config.robust_b > 0)
+        or "edge_drop_prob" in sweep
+    ):
+        # Mirror the sequential path's centralized rejection: silently
+        # running a benign program here would break the replica-r ==
+        # run(rep_cfgs[r]) contract (the sequential run raises).
+        raise ValueError(
+            "fault injection / matching-based gossip / Byzantine "
+            "injection model peer exchanges and apply only to "
+            "decentralized algorithms; the centralized pattern has no "
+            "peer edges"
+        )
+    if "edge_drop_prob" in sweep and not all(
+        0.0 < float(v) < 1.0 for v in sweep["edge_drop_prob"]
+    ):
+        raise ValueError(
+            "swept edge_drop_prob values must all be in (0, 1): the "
+            "batched fault threshold is traced data, so every replica "
+            "must run the fault-sampling path (p = 0 rows belong in a "
+            "separate fault-free batch)"
+        )
+    if "clip_tau" in sweep:
+        if config.aggregation != "clipped_gossip" or config.robust_b <= 0:
+            raise ValueError(
+                "sweeping clip_tau requires aggregation='clipped_gossip' "
+                "with robust_b > 0 — otherwise the radius is silently "
+                "ignored"
+            )
+        if not all(float(v) > 0.0 for v in sweep["clip_tau"]):
+            raise ValueError(
+                "swept clip_tau values must all be > 0: the adaptive "
+                "radius (clip_tau=0) is a different traced program — run "
+                "it as its own batch"
+            )
+    # Per-replica sequential-equivalent configs: this DEFINES the batched
+    # semantics (replica r == run(rep_cfgs[r])) and validates every cell
+    # through the frozen dataclass's own cross-field checks. The topology
+    # seed is pinned to the base config's resolved value — the graph is
+    # structural (a per-replica graph cannot batch), so a seed sweep
+    # varies run randomness over ONE fixed graph instance, and each
+    # rep_cfg names exactly that run.
+    rep_cfgs = [
+        config.replace(
+            seed=s,
+            topology_seed=config.resolved_topology_seed(),
+            **{f: type(getattr(config, f))(vals[r])
+               for f, vals in sweep.items()},
+        )
+        for r, s in enumerate(seeds)
+    ]
+
+    algo = get_algorithm(config.algorithm)
+    problem = get_problem(
+        config.problem_type, huber_delta=config.huber_delta,
+        n_classes=config.n_classes,
+    )
+    reg = config.reg_param
+    T = config.n_iterations
+    n = config.n_workers
+    horizon = t0 + T  # fault timelines are prefix-stable in the horizon
+
+    device_data = stack_shards(dataset, dtype=np.dtype(config.dtype))
+    d_model = problem.param_dim(device_data.n_features)
+
+    # --- static (replica-shared) topology & mixing ---------------------
+    # The graph is anchored on the BASE config's seed: the replica axis
+    # sweeps run randomness (sampling, faults, adversary draws) over one
+    # fixed problem instance + topology, which is what mean ± std over
+    # replicates measures.
+    if algo.is_decentralized:
+        topo = build_topology(
+            config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
+            seed=config.resolved_topology_seed(),
+        )
+        mix_op = make_mixing_op(
+            topo, impl=config.mixing_impl, dtype=device_data.X.dtype
+        )
+        degrees = jnp.asarray(topo.degrees, dtype=device_data.X.dtype)[:, None]
+        if algo.comm_payload is not None:
+            edge_payload = algo.comm_payload(config, d_model)
+            floats_per_iter = topo.floats_per_iteration * edge_payload
+        else:
+            edge_payload = d_model * algo.gossip_rounds
+            floats_per_iter = decentralized_floats_per_iteration(
+                topo, d_model, algo.gossip_rounds
+            )
+        spectral_gap = topo.spectral_gap
+    else:
+        topo = None
+        mix_op = None
+        edge_payload = None
+        degrees = jnp.zeros((n, 1), dtype=device_data.X.dtype)
+        floats_per_iter = centralized_floats_per_iteration(n, d_model)
+        spectral_gap = None
+
+    time_varying = (
+        config.edge_drop_prob > 0.0
+        or config.straggler_prob > 0.0
+        or config.mttf > 0.0
+        or config.gossip_schedule != "synchronous"
+        or "edge_drop_prob" in sweep
+    )
+    byzantine_active = config.attack != "none" or (
+        config.aggregation != "gossip" and config.robust_b > 0
+    )
+    use_timeline = config.burst_len >= 1.0 or config.mttf > 0.0
+
+    # --- per-replica randomness, derived host-side ---------------------
+    # Identical formulas to the sequential path's (jax.random.key(seed) +
+    # the fault/adversary stream tags), stacked over the replica axis.
+    rp: dict = {"key": jnp.stack([jax.random.key(s) for s in seeds])}
+    if algo.is_decentralized and time_varying:
+        rp["fault_key"] = jnp.stack([
+            jax.random.fold_in(jax.random.key(s), 0x0FA17) for s in seeds
+        ])
+        rp["node_key"] = jnp.stack([
+            jax.random.fold_in(jax.random.key(s), 0x57A66) for s in seeds
+        ])
+        rp["match_key"] = jnp.stack([
+            jax.random.fold_in(jax.random.key(s), 0x3A7C4) for s in seeds
+        ])
+    stacked_tl = None
+    if algo.is_decentralized and use_timeline:
+        stacked_tl = stack_fault_timelines([
+            build_fault_timeline(
+                topo, horizon, c.seed,
+                edge_drop_prob=c.edge_drop_prob,
+                burst_len=c.burst_len if c.burst_len >= 1.0 else 1.0,
+                straggler_prob=0.0 if c.mttf > 0.0 else c.straggler_prob,
+                mttf=c.mttf, mttr=c.mttr,
+            )
+            for c in rep_cfgs
+        ])
+        if stacked_tl.edge_up is not None:
+            rp["tl_edge_up"] = jnp.asarray(stacked_tl.edge_up)
+        if stacked_tl.node_up is not None:
+            rp["tl_node_up"] = jnp.asarray(stacked_tl.node_up)
+        if stacked_tl.rejoin is not None:
+            rp["tl_rejoin"] = jnp.asarray(stacked_tl.rejoin)
+    byz_hosts = None
+    if byzantine_active and config.attack != "none":
+        byz_hosts = np.stack([
+            byzantine_mask(n, config.n_byzantine, s) for s in seeds
+        ])
+        rp["byz"] = jnp.asarray(byz_hosts)
+        rp["noise_key"] = jnp.stack([
+            jax.random.fold_in(jax.random.key(s), _BYZ_NOISE_TAG)
+            for s in seeds
+        ])
+    if "learning_rate_eta0" in sweep:
+        rp["eta0"] = jnp.asarray(
+            np.asarray(sweep["learning_rate_eta0"], dtype=np.float64)
+        )
+    if "clip_tau" in sweep:
+        rp["clip_tau"] = jnp.asarray(
+            np.asarray(sweep["clip_tau"], dtype=np.float64)
+        )
+    if "edge_drop_prob" in sweep:
+        # float32: the fault threshold's comparison dtype everywhere.
+        rp["edge_drop_prob"] = jnp.asarray(
+            np.asarray(sweep["edge_drop_prob"], dtype=np.float32)
+        )
+
+    # --- data + initial state (unsharded; replica axis fills the chip) --
+    data_args = {
+        "X": jnp.asarray(device_data.X),
+        "y": jnp.asarray(device_data.y),
+        "n_valid": jnp.asarray(device_data.n_valid),
+    }
+    x0 = jnp.zeros((n, d_model), dtype=device_data.X.dtype)
+    st0 = algo.init(
+        x0, config,
+        neighbor_sum=mix_op.neighbor_sum if mix_op is not None else None,
+    )
+    if state0 is None:
+        state0_R = jax.tree.map(
+            lambda a: jnp.repeat(a[None], R, axis=0), st0
+        )
+    else:
+        if set(state0) != set(st0):
+            raise ValueError(
+                f"state0 leaves {sorted(state0)} do not match the "
+                f"algorithm's state {sorted(st0)}"
+            )
+        state0_R = {
+            k: jnp.asarray(v).astype(st0[k].dtype) for k, v in state0.items()
+        }
+        for k, v in state0_R.items():
+            if v.shape != (R,) + st0[k].shape:
+                raise ValueError(
+                    f"state0[{k!r}] has shape {v.shape}; expected "
+                    f"{(R,) + st0[k].shape} ([replicas, ...])"
+                )
+
+    full_objective = make_full_objective_fn(problem, reg)
+    batch_size = config.local_batch_size
+    platform = jax.devices()[0].platform
+    sampling_impl = config.resolved_sampling_impl(
+        platform, device_data.X.shape[1]
+    )
+    track_consensus = (
+        collect_metrics and algo.is_decentralized and config.record_consensus
+    )
+    eval_every = config.eval_every
+    n_evals = T // eval_every
+    scan_unroll = config.resolved_scan_unroll(platform)
+    micro, trips_per_eval, flat_unroll = _flat_scan_cadence(
+        scan_unroll, eval_every
+    )
+    n_trips = n_evals * trips_per_eval
+
+    def replica_scan(rp_r, state_init, t0_dev, data):
+        """One replica's flat fused scan — the sequential program, traced
+        with this replica's randomness/scalars bound from ``rp_r``."""
+        faulty = None
+        adversary = None
+        byz_mix = None
+        honest_w = None
+        if algo.is_decentralized:
+            tl = None
+            if stacked_tl is not None:
+                tl = FaultTimeline(
+                    horizon=horizon,
+                    directed=topo.directed,
+                    edge_index=stacked_tl.edge_index,
+                    edge_up=rp_r.get("tl_edge_up"),
+                    node_up=rp_r.get("tl_node_up"),
+                    rejoin=rp_r.get("tl_rejoin"),
+                )
+            if time_varying:
+                faulty = _build_faulty(
+                    config, algo, topo, T,
+                    drop_prob=rp_r.get("edge_drop_prob"),
+                    keys=(
+                        rp_r["fault_key"], rp_r["node_key"],
+                        rp_r["match_key"],
+                    ),
+                    timeline=tl, horizon=horizon,
+                )
+            adversary, byz_mix = _bind_byzantine(
+                config, algo, topo, faulty, mix_op,
+                clip_tau=rp_r.get("clip_tau"),
+                byz=rp_r.get("byz"),
+                noise_key=rp_r.get("noise_key"),
+            )
+            if adversary is not None:
+                honest_w = jnp.asarray(
+                    adversary.honest.astype(np.float32)
+                )
+        pieces = _StepPieces(
+            algo=algo, problem=problem, reg=reg, config=config,
+            batch_size=batch_size, sampling_impl=sampling_impl,
+            key=rp_r["key"],
+            eta_fn=_make_eta_fn(config, eta0=rp_r.get("eta0")),
+            degrees=degrees, mix_op=mix_op, faulty=faulty,
+            byz_mix=byz_mix, adversary=adversary, honest_w=honest_w,
+            fused_mix_step=None, full_objective=full_objective,
+            f_opt=f_opt, collect_metrics=collect_metrics,
+            track_consensus=track_consensus, edge_payload=edge_payload,
+        )
+        step, eval_metrics, floats_for = _make_step_eval(pieces, data)
+
+        def microchunk(state, ts_row):
+            for j in range(micro):
+                state, _ = step(state, ts_row[j])
+            out = eval_metrics(state) if collect_metrics else {}
+            if faulty is not None:
+                out["floats"] = floats_for(ts_row)
+            return state, out
+
+        ts = (
+            t0_dev + jnp.arange(n_trips * micro, dtype=jnp.int32)
+        ).reshape(n_trips, micro)
+        return jax.lax.scan(microchunk, state_init, ts, unroll=flat_unroll)
+
+    rp_axes = {k: 0 for k in rp}
+    batched = jax.vmap(replica_scan, in_axes=(rp_axes, 0, None, None))
+    t0_dev = jnp.asarray(t0, dtype=jnp.int32)
+
+    t_c = time.perf_counter()
+    with jax.default_matmul_precision(config.matmul_precision):
+        compiled = (
+            jax.jit(batched)
+            .lower(rp, state0_R, t0_dev, data_args)
+            .compile()
+        )
+    compile_seconds = time.perf_counter() - t_c if measure_compile else 0.0
+
+    t_r = time.perf_counter()
+    final_states, ys = compiled(rp, state0_R, t0_dev, data_args)
+    final_states = jax.block_until_ready(final_states)
+    run_seconds = time.perf_counter() - t_r
+
+    # --- harvest [R, n_trips, ...] scan outputs to per-eval rows --------
+    sel = slice(trips_per_eval - 1, None, trips_per_eval)
+    gap = (
+        np.asarray(ys["gap"], dtype=np.float64)[:, sel]
+        if "gap" in ys else None
+    )
+    cons = (
+        np.asarray(ys["cons"], dtype=np.float64)[:, sel]
+        if "cons" in ys else None
+    )
+    floats = (
+        np.asarray(ys["floats"], dtype=np.float64)
+        .reshape(R, n_evals, trips_per_eval).sum(axis=2)
+        if "floats" in ys else None
+    )
+    objective = gap if gap is not None else np.full((R, n_evals), np.nan)
+
+    final_states_np = {
+        k: np.asarray(v) for k, v in final_states.items()
+    }
+    final_models = final_states_np["x"].astype(np.float64)  # [R, N, d]
+    aggregate_ips = (
+        R * T / run_seconds if run_seconds > 0 else float("nan")
+    )
+    time_hist = np.linspace(
+        run_seconds / max(n_evals, 1), run_seconds, n_evals
+    )
+    eval_iterations = np.arange(
+        t0 + eval_every, t0 + T + 1, eval_every
+    )
+
+    results = []
+    for r in range(R):
+        total_floats = (
+            float(floats[r].sum()) if floats is not None
+            else floats_per_iter * T
+        )
+        history = RunHistory(
+            objective=objective[r],
+            consensus_error=cons[r] if cons is not None else None,
+            time=time_hist,
+            time_measured=False,
+            eval_iterations=eval_iterations,
+            total_floats_transmitted=total_floats,
+            # The batch time-slices the chip evenly: each replica's share
+            # of the aggregate throughput.
+            iters_per_second=aggregate_ips / R,
+            compile_seconds=compile_seconds,
+            spectral_gap=spectral_gap,
+        )
+        models_r = final_models[r]
+        if byz_hosts is not None:
+            final_avg = models_r[~byz_hosts[r]].mean(axis=0)
+        else:
+            final_avg = models_r.mean(axis=0)
+        results.append(BackendRunResult(
+            history=history,
+            final_models=models_r,
+            final_avg_model=final_avg,
+        ))
+
+    return BatchRunResult(
+        results=results,
+        seeds=seeds,
+        sweep=sweep or None,
+        objective=objective,
+        consensus_error=cons,
+        aggregate_iters_per_second=aggregate_ips,
+        run_seconds=run_seconds,
+        compile_seconds=compile_seconds,
+        final_states=final_states_np,
     )

@@ -176,7 +176,8 @@ def run(
         )
     if algo.is_decentralized:
         topo = build_topology(
-            config.topology, n, erdos_renyi_p=config.erdos_renyi_p, seed=config.seed
+            config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
+            seed=config.resolved_topology_seed(),
         )
         W = topo.mixing_matrix
         A = topo.adjacency

@@ -249,10 +249,34 @@ def build_parser() -> argparse.ArgumentParser:
                           "non-IID sort-by-target slices; 'shuffled' = "
                           "IID control (bounded heterogeneity)")
     opt.add_argument("--seed", type=int, default=_DEFAULTS.seed)
+    opt.add_argument("--topology-seed", type=int,
+                     default=_DEFAULTS.topology_seed,
+                     help="pin the random-topology (Erdős–Rényi) edge "
+                          "draws independently of --seed (-1 = follow "
+                          "--seed); replicated runs pin it automatically "
+                          "so every replica shares one graph instance")
+    opt.add_argument("--replicas", type=int, default=_DEFAULTS.replicas,
+                     help="run this many seed replicates (seed, seed+1, "
+                          "...) as ONE vmapped jax program and report "
+                          "mean ± std over the replica axis (jax backend "
+                          "only; docs/PERF.md 'Replica-batched sweeps')")
+    opt.add_argument("--seeds", metavar="S1,S2,...", default=None,
+                     help="explicit comma-separated replica seed list "
+                          "(overrides --replicas/--seed's arithmetic "
+                          "progression); implies replica-batched "
+                          "execution")
     opt.add_argument("--suboptimality-threshold", type=float,
                      default=_DEFAULTS.suboptimality_threshold)
 
     execg = p.add_argument_group("execution")
+    execg.add_argument("--tp", type=int, default=_DEFAULTS.tp_degree,
+                       metavar="TP_DEGREE",
+                       help="tensor parallelism: shard the softmax [d, K] "
+                            "classifier over TP_DEGREE devices of a 2-D "
+                            "(workers, model) mesh (jax backend; supported "
+                            "combination: softmax + dsgd + ring + full "
+                            "local batches — anything else is rejected "
+                            "with the reason). 1 = pure data parallelism")
     execg.add_argument("--eval-every", type=int, default=_DEFAULTS.eval_every,
                        help="full-data objective eval cadence (1 = reference "
                             "parity)")
@@ -349,6 +373,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         compression_k=args.compression_k,
         choco_gamma=args.choco_gamma,
         seed=args.seed,
+        topology_seed=args.topology_seed,
+        replicas=args.replicas,
+        tp_degree=args.tp,
         eval_every=args.eval_every,
         erdos_renyi_p=args.erdos_renyi_p,
         edge_drop_prob=args.edge_drop_prob,
@@ -432,6 +459,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.suite and args.topology == "grid":
         args.topology = _DEFAULTS.topology
 
+    seeds_list = None
+    if args.seeds:
+        try:
+            seeds_list = [int(x) for x in args.seeds.split(",") if x.strip()]
+        except ValueError:
+            raise SystemExit(
+                f"--seeds must be a comma-separated integer list, got "
+                f"{args.seeds!r}"
+            )
+        if not seeds_list:
+            raise SystemExit("--seeds needs at least one seed")
+        # The explicit list defines the replica axis; seed[0] anchors
+        # everything else that derives from the base seed (the dataset,
+        # and the topology unless --topology-seed pins it).
+        args.replicas = len(seeds_list)
+        args.seed = seeds_list[0]
+
     config = config_from_args(args)
 
     from distributed_optimization_tpu.simulator import Simulator
@@ -443,6 +487,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         dataset = generate_digits_dataset(config)
 
     run_kwargs = {}
+    replicated = config.replicas > 1 or seeds_list is not None
+    if replicated:
+        if seeds_list is not None:
+            run_kwargs["seeds"] = seeds_list
+        if args.checkpoint_dir:
+            raise SystemExit(
+                "--checkpoint-dir does not compose with --replicas/--seeds: "
+                "continue a batch programmatically via run_batch(state0=, "
+                "t0=) instead"
+            )
+        if args.measure_time:
+            raise SystemExit(
+                "--measure-time does not compose with --replicas/--seeds: "
+                "the batched program is one fused vmapped scan with no "
+                "per-eval host sync"
+            )
     if args.checkpoint_dir:
         if args.backend != "jax":
             raise SystemExit("--checkpoint-dir requires --backend jax")

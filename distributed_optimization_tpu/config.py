@@ -60,6 +60,15 @@ REJOINS = ("frozen", "neighbor_restart")
 # with robust_b == 0 degrades to exactly plain gossip.
 AGGREGATIONS = ("gossip", "trimmed_mean", "median", "clipped_gossip")
 
+# Per-replica scalar axes ``jax_backend.run_batch`` can sweep alongside the
+# seed axis (each replica r behaves exactly like a sequential run of
+# ``config.replace(seed=seeds[r], **{field: values[r]})``). Only scalars
+# that enter the compiled program as data — the LR schedule's eta0, the
+# clipping radius, the edge-drop threshold — batch this way; structural
+# fields (topology, n_workers, algorithm, ...) change the traced program
+# itself and are rejected with a pointer to running separate sweeps.
+SWEEPABLE_FIELDS = ("learning_rate_eta0", "clip_tau", "edge_drop_prob")
+
 # Default Huber transition point δ: fixed at the synthetic data's noise scale
 # (make_regression noise=10.0, utils/data.py), i.e. the kink sits at ~1σ of the
 # residuals at the optimum — the classical choice. δ is data-scale-dependent,
@@ -132,6 +141,16 @@ class ExperimentConfig:
     # breakdown point is only visible without the sorted skew).
     partition: str = "sorted"
     seed: int = 203  # reference seeds np.random.seed(203) at main.py:24
+    # Seed for the TOPOLOGY's random structure (Erdős–Rényi edge draws)
+    # when it should NOT follow ``seed``: −1 (default) derives the graph
+    # from ``seed`` as always; >= 0 pins the graph independently, so a
+    # seed sweep (``replicas`` / run_batch) varies run randomness —
+    # sampling, faults, adversary draws — over ONE fixed graph instance.
+    # The replica-batched path pins this automatically (the graph is
+    # structural: a per-replica graph cannot batch), making each batched
+    # replica exactly equivalent to a sequential run of its per-replica
+    # config. Deterministic topologies ignore it.
+    topology_seed: int = -1
     eval_every: int = 1  # full-data objective eval cadence (reference: every iter)
     erdos_renyi_p: float = 0.4  # edge probability for the ER topology
     # Failure injection (SURVEY.md §5.3): per-iteration iid probability that
@@ -226,6 +245,20 @@ class ExperimentConfig:
     dtype: str = "float32"
     matmul_precision: str = "highest"  # jax.lax Precision for parity-sensitive math
     record_consensus: bool = True
+    # Replica-batched execution (jax backend): run this many independent
+    # seed replicates — seeds seed, seed+1, ..., seed+replicas−1 — through
+    # ONE vmapped compiled program ([R, N, d] state, [R, n_evals] metrics)
+    # instead of sequential compiled runs, and report mean ± std over the
+    # replica axis. 1 = the single-trajectory path (unchanged). Each
+    # replica is trajectory-equivalent to a sequential run with its seed
+    # (tests pin ≤ 1e-12 in f64 through the fault and Byzantine layers).
+    replicas: int = 1
+    # Tensor parallelism for the compute-bound softmax tier: shard the
+    # [d, K] classifier over a 'model' mesh axis of this many devices
+    # (parallel/tensor_parallel.py — D-SGD + ring + softmax + full local
+    # batches only; every other combination is rejected below with the
+    # reason). 1 = pure data parallelism (unchanged).
+    tp_degree: int = 1
 
     def __post_init__(self) -> None:
         if self.problem_type not in PROBLEM_TYPES:
@@ -463,6 +496,112 @@ class ExperimentConfig:
                 "algorithm='push_sum', which debiases by the tracked "
                 "push-sum mass"
             )
+        if self.topology_seed < -1:
+            raise ValueError(
+                f"topology_seed must be -1 (follow seed) or >= 0, got "
+                f"{self.topology_seed}"
+            )
+        if self.replicas < 1:
+            raise ValueError(
+                f"replicas must be >= 1, got {self.replicas}"
+            )
+        if self.replicas > 1:
+            if self.backend != "jax":
+                raise ValueError(
+                    f"replicas={self.replicas} batches seed replicates "
+                    "through one vmapped XLA program, which only the jax "
+                    "backend compiles; the numpy/cpp backends run one "
+                    "trajectory at a time — use backend='jax' or loop "
+                    "single runs"
+                )
+            if self.mixing_impl in ("shard_map", "pallas"):
+                raise ValueError(
+                    f"replicas={self.replicas} is incompatible with "
+                    f"mixing_impl={self.mixing_impl!r}: the replica axis "
+                    "vmaps the whole compiled program, but shard_map "
+                    "stencils pin a fixed device mesh and the pallas "
+                    "kernels address unbatched VMEM blocks — use 'auto', "
+                    "'dense', 'stencil', or 'sparse'"
+                )
+            if self.algorithm == "choco":
+                raise ValueError(
+                    "replicas > 1 is unsupported for 'choco': its step "
+                    "rule derives the compressor stream from config.seed "
+                    "internally, which a batched per-replica seed axis "
+                    "cannot reach — replicas would silently share "
+                    "compression draws; run seeds sequentially instead"
+                )
+        if self.tp_degree < 1:
+            raise ValueError(
+                f"tp_degree must be >= 1, got {self.tp_degree}"
+            )
+        if self.tp_degree > 1:
+            if self.backend != "jax":
+                raise ValueError(
+                    "tp_degree > 1 shards the model over a jax device "
+                    f"mesh; backend={self.backend!r} has no mesh — use "
+                    "backend='jax'"
+                )
+            if self.problem_type != "softmax":
+                raise ValueError(
+                    f"tp_degree={self.tp_degree} shards the softmax "
+                    "[d, K] classifier over class columns; problem_type="
+                    f"{self.problem_type!r} has a flat parameter vector "
+                    "with no model axis to shard — use "
+                    "problem_type='softmax'"
+                )
+            if self.algorithm != "dsgd" or self.topology != "ring":
+                raise ValueError(
+                    "the tensor-parallel path implements D-SGD ring "
+                    "gossip on the class-sharded slice (the compute "
+                    f"tier's measured configuration); algorithm="
+                    f"{self.algorithm!r} topology={self.topology!r} is "
+                    "unsupported — use algorithm='dsgd', topology='ring'"
+                )
+            if self.n_classes % self.tp_degree != 0:
+                raise ValueError(
+                    f"tp_degree={self.tp_degree} must divide n_classes "
+                    f"({self.n_classes}): the [d, K] matrix shards in "
+                    "equal class-column blocks"
+                )
+            if (
+                self.edge_drop_prob > 0.0
+                or self.straggler_prob > 0.0
+                or self.mttf > 0.0
+                or self.gossip_schedule != "synchronous"
+                or self.attack != "none"
+                or self.aggregation != "gossip"
+            ):
+                raise ValueError(
+                    "tp_degree > 1 does not compose with fault injection, "
+                    "matching schedules, or Byzantine machinery: the TP "
+                    "ring stencil is a fixed boundary ppermute over the "
+                    "workers mesh axis, not a per-iteration realized "
+                    "graph — run those studies on the data-parallel path"
+                )
+            if self.replicas > 1:
+                raise ValueError(
+                    "tp_degree > 1 and replicas > 1 are mutually "
+                    "exclusive: the TP path pins a 2-D (workers, model) "
+                    "device mesh that the replica vmap axis cannot wrap"
+                )
+            if self.mixing_impl not in ("auto", "stencil"):
+                raise ValueError(
+                    f"tp_degree > 1 realizes ring gossip as its own "
+                    f"boundary-exchange stencil; mixing_impl="
+                    f"{self.mixing_impl!r} would be silently ignored — "
+                    "use 'auto'"
+                )
+
+    def resolved_topology_seed(self) -> int:
+        """The seed random topologies actually build from: ``topology_seed``
+        when pinned (>= 0), else ``seed``."""
+        return self.topology_seed if self.topology_seed >= 0 else self.seed
+
+    def replica_seeds(self) -> list[int]:
+        """The per-replica seed vector a replicated run sweeps: seed,
+        seed+1, ..., seed+replicas−1 (length 1 for single runs)."""
+        return [self.seed + r for r in range(self.replicas)]
 
     def resolved_sampling_impl(self, platform: str, n_local: int) -> str:
         """Resolve sampling_impl='auto' from measured data.

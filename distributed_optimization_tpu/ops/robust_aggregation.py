@@ -171,6 +171,10 @@ def make_robust_aggregator(
             return med[:, 0, :].astype(x.dtype)
 
     else:  # clipped_gossip
+        # Adaptive vs fixed radius is a HOST decision: a traced clip_tau (a
+        # replica-swept axis, run_batch-validated > 0) is always the fixed
+        # form — only a concrete 0.0 selects the adaptive per-node radius.
+        adaptive_tau = isinstance(clip_tau, (int, float)) and clip_tau <= 0.0
 
         def aggregate(A, x):
             acc = jnp.promote_types(jnp.float32, x.dtype)
@@ -179,7 +183,7 @@ def make_robust_aggregator(
             W = metropolis_hastings_weights(Aa)
             diffs = xa[None, :, :] - xa[:, None, :]  # [recv i, send j, d]
             norms = jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
-            if clip_tau > 0.0:
+            if not adaptive_tau:
                 tau = jnp.full(A.shape[0], clip_tau, dtype=acc)
             else:
                 # Adaptive radius: the (deg−b)-th smallest neighbor
@@ -278,6 +282,9 @@ def make_gather_robust_aggregator(
             return med[:, 0, :].astype(x.dtype)
 
     else:  # clipped_gossip
+        # Same host decision as the dense twin: traced clip_tau (a swept
+        # replica axis) is the fixed form; concrete 0.0 is adaptive.
+        adaptive_tau = isinstance(clip_tau, (int, float)) and clip_tau <= 0.0
 
         def aggregate(live, x):
             acc = jnp.promote_types(jnp.float32, x.dtype)
@@ -286,7 +293,7 @@ def make_gather_robust_aggregator(
             deg = jnp.sum(lv, axis=1)  # realized degrees [N]
             diffs = xa[nbr] - xa[:, None, :]  # [recv i, slot, d]
             norms = jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
-            if clip_tau > 0.0:
+            if not adaptive_tau:
                 tau = jnp.full(nbr.shape[0], clip_tau, dtype=acc)
             else:
                 # Adaptive radius: the (deg−b)-th smallest realized

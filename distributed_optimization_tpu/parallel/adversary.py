@@ -95,15 +95,27 @@ def make_adversary(
     n_byzantine: int,
     attack_scale: float,
     seed: int,
+    *,
+    byz=None,
+    noise_key=None,
 ) -> Optional[Adversary]:
-    """Build the jit-compatible adversary for a config (None when benign)."""
+    """Build the jit-compatible adversary for a config (None when benign).
+
+    ``byz``/``noise_key`` override the seed-derived Byzantine set and
+    large-noise stream — the replica-batched path
+    (``jax_backend.run_batch``) derives both per replica host-side (the
+    identical ``byzantine_mask``/fold-in formulas) and threads them
+    through ``vmap``, so they may be tracers here.
+    """
     if attack not in ATTACKS:
         raise ValueError(f"Unknown attack: {attack}")
     if attack == "none":
         return None
-    byz = byzantine_mask(n_workers, n_byzantine, seed)
+    if byz is None:
+        byz = byzantine_mask(n_workers, n_byzantine, seed)
     byz_dev = jnp.asarray(byz, dtype=jnp.float32)
-    noise_key = jax.random.fold_in(jax.random.key(seed), _BYZ_NOISE_TAG)
+    if noise_key is None:
+        noise_key = jax.random.fold_in(jax.random.key(seed), _BYZ_NOISE_TAG)
 
     def corrupt(t, x):
         acc = jnp.promote_types(jnp.float32, x.dtype)

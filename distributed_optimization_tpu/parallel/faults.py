@@ -596,6 +596,47 @@ def windowed_connectivity(
     return lo
 
 
+def stack_fault_timelines(timelines: list[FaultTimeline]) -> FaultTimeline:
+    """Stack per-replica timelines into one with [R, ...] leading axes.
+
+    The replica-batched execution path (``jax_backend.run_batch``) builds
+    one timeline per replica seed host-side, stacks them here, threads the
+    stacked arrays through ``vmap`` (in_axes=0), and reconstitutes a
+    per-replica ``FaultTimeline`` view inside the traced program — so the
+    batched fault realizations are the SAME host arrays the sequential
+    runs gather from.  ``edge_index`` is topology-static and shared; the
+    fault-process structure (which arrays are present) must match across
+    replicas (same config, different seeds).
+    """
+    if not timelines:
+        raise ValueError("need at least one timeline to stack")
+    t0 = timelines[0]
+    for t in timelines[1:]:
+        if (
+            t.horizon != t0.horizon
+            or t.directed != t0.directed
+            or (t.edge_up is None) != (t0.edge_up is None)
+            or (t.node_up is None) != (t0.node_up is None)
+        ):
+            raise ValueError(
+                "timelines disagree in structure (horizon / fault modes); "
+                "replica stacking requires one config over many seeds"
+            )
+
+    def _stack(field):
+        vals = [getattr(t, field) for t in timelines]
+        return np.stack(vals) if vals[0] is not None else None
+
+    return FaultTimeline(
+        horizon=t0.horizon,
+        directed=t0.directed,
+        edge_index=t0.edge_index,
+        edge_up=_stack("edge_up"),
+        node_up=_stack("node_up"),
+        rejoin=_stack("rejoin"),
+    )
+
+
 def make_faulty_mixing(
     topo: Topology,
     drop_prob: float,
@@ -607,6 +648,8 @@ def make_faulty_mixing(
     mttr: float = 0.0,
     rejoin: str = "frozen",
     horizon: Optional[int] = None,
+    keys: Optional[tuple] = None,
+    timeline: Optional[FaultTimeline] = None,
 ) -> FaultyMixing:
     """Build time-varying mixing operators for a base topology.
 
@@ -620,9 +663,25 @@ def make_faulty_mixing(
     require ``horizon`` and route through a precomputed
     ``build_fault_timeline`` (gathered per iteration; bitwise-identical to
     the on-the-fly path at burst_len=1 / the iid-equivalent churn point).
+
+    Replica-batched callers (``jax_backend.run_batch``) override the
+    seed-derived randomness per replica: ``keys`` = (fault_key, node_key,
+    match_key) pre-derived typed PRNG keys (may be vmap tracers), and
+    ``timeline`` = a prebuilt per-replica ``FaultTimeline`` whose arrays
+    may be traced [horizon, ...] slices of a stacked replica axis.
+    ``drop_prob`` may then also be a traced scalar (a swept axis); traced
+    values skip the host-side range validation — the batch caller
+    validates per-replica configs before tracing — and always take the
+    sampling path (a draw ``u >= p`` with p = 0 keeps every edge, so the
+    realization stays correct for any in-range value).
     """
-    if not 0.0 <= drop_prob < 1.0:
+    drop_concrete = isinstance(drop_prob, (int, float))
+    if drop_concrete and not 0.0 <= drop_prob < 1.0:
         raise ValueError(f"drop_prob must be in [0, 1), got {drop_prob}")
+    # Host-side activity flags: traced drop probabilities always run the
+    # sampling math (correct for any value — see the docstring).
+    drop_active = (not drop_concrete) or drop_prob > 0.0
+    strag_active = straggler_prob > 0.0
     if not 0.0 <= straggler_prob < 1.0:
         raise ValueError(
             f"straggler_prob must be in [0, 1), got {straggler_prob}"
@@ -648,9 +707,8 @@ def make_faulty_mixing(
             "policies act on the realized neighborhood, which a one-peer "
             "matching (at most one partner per round) cannot supply"
         )
-    use_timeline = burst_len >= 1.0 or churn_active
-    timeline = None
-    if use_timeline:
+    use_timeline = burst_len >= 1.0 or churn_active or timeline is not None
+    if use_timeline and timeline is None:
         if horizon is None:
             raise ValueError(
                 "persistent fault processes (burst_len >= 1 or mttf/mttr) "
@@ -665,9 +723,13 @@ def make_faulty_mixing(
             mttf=mttf, mttr=mttr,
         )
     base_A = jnp.asarray(topo.adjacency, dtype=jnp.float32)
-    # Distinct streams from batch sampling: fold tags into the seed key.
-    fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
-    node_key = jax.random.fold_in(jax.random.key(seed), 0x57A66)
+    # Distinct streams from batch sampling: fold tags into the seed key
+    # (or take the caller's pre-derived per-replica keys verbatim).
+    if keys is None:
+        fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
+        node_key = jax.random.fold_in(jax.random.key(seed), 0x57A66)
+    else:
+        fault_key, node_key, _ = keys
 
     if use_timeline:
         node_up_dev = (
@@ -701,7 +763,7 @@ def make_faulty_mixing(
     else:
 
         def active(t) -> jax.Array:
-            if straggler_prob == 0.0:
+            if not strag_active:
                 return jnp.ones(base_A.shape[0], dtype=jnp.float32)
             key = jax.random.fold_in(node_key, t)
             u = jax.random.uniform(
@@ -710,7 +772,7 @@ def make_faulty_mixing(
             return (u >= straggler_prob).astype(jnp.float32)
 
         def realized_adjacency(t) -> jax.Array:
-            if drop_prob == 0.0 and straggler_prob == 0.0:
+            if not drop_active and not strag_active:
                 return base_A  # no fault sampling on the fault-free fast path
             key = jax.random.fold_in(fault_key, t)
             if topo.directed:
@@ -719,7 +781,7 @@ def make_faulty_mixing(
                 )
             else:
                 A_t = sample_surviving_adjacency(key, base_A, drop_prob)
-            if straggler_prob > 0.0:
+            if strag_active:
                 m = active(t)
                 A_t = A_t * m[:, None] * m[None, :]  # exchanges nothing
             return A_t
@@ -764,10 +826,10 @@ def make_faulty_mixing(
         else:
 
             def live(t) -> jax.Array:
-                if drop_prob == 0.0 and straggler_prob == 0.0:
+                if not drop_active and not strag_active:
                     return mask_dev  # fault-free fast path: static table
                 out = mask_dev
-                if drop_prob > 0.0:
+                if drop_active:
                     # The SAME symmetric (seed, t) draw as
                     # sample_surviving_adjacency, gathered per slot — the
                     # O(N²) uniform matrix carries no d factor, so the
@@ -779,7 +841,7 @@ def make_faulty_mixing(
                     out = out * (
                         jnp.take_along_axis(u, nbr_dev, axis=1) >= drop_prob
                     ).astype(jnp.float32)
-                if straggler_prob > 0.0:
+                if strag_active:
                     m = active(t)
                     out = out * m[:, None] * m[nbr_dev]
                 return out
@@ -808,7 +870,10 @@ def make_faulty_mixing(
                 take[:, None], nbr_avg, x.astype(acc)
             ).astype(x.dtype)
 
-    match_key = jax.random.fold_in(jax.random.key(seed), 0x3A7C4)
+    match_key = (
+        jax.random.fold_in(jax.random.key(seed), 0x3A7C4)
+        if keys is None else keys[2]
+    )
 
     def partner(t) -> jax.Array:
         key = jax.random.fold_in(match_key, t)

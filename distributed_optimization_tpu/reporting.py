@@ -43,6 +43,55 @@ def format_report(records, config, f_opt: float) -> str:
         if rec.skipped_reason is not None:
             lines.append(f"{rec.label:<28}{'N/A — ' + rec.skipped_reason}")
             continue
+        stats = getattr(rec, "replicate_stats", None)
+        if stats is not None:
+            # Replica-batched row (ISSUE-4): every quoted number is a
+            # mean ± std over the seed replicates, not one trajectory's.
+            if stats.n_reached:
+                iters = (
+                    f"{stats.iterations_to_threshold_mean:.0f}"
+                    f"±{stats.iterations_to_threshold_std:.0f}"
+                )
+                if stats.n_reached < stats.n_replicas:
+                    iters += f" ({stats.n_reached}/{stats.n_replicas})"
+            else:
+                iters = "never"
+            s = rec.summary
+            gap = (
+                f"{s.spectral_gap:.4f}" if s.spectral_gap is not None else "—"
+            )
+            lines.append(
+                # The mean±std iters→ε spans the iters→ε + sec→ε columns
+                # (per-eval wall-clock is batch-wide, so sec→ε has no
+                # per-replica meaning).
+                f"{rec.label + f' [R={stats.n_replicas}]':<28}{iters:>17}"
+                f"{_fmt_sci(s.total_transmission_floats):>14}"
+                f"{_fmt_sci(s.avg_worker_transmission_floats):>15}{gap:>8}"
+                f"{stats.aggregate_iters_per_second:>10.1f}"
+            )
+            cons = (
+                f", consensus {stats.consensus_mean:.3e} ± "
+                f"{stats.consensus_std:.3e}"
+                if stats.consensus_mean is not None else ""
+            )
+            # 'a..b' only for a genuinely consecutive seed vector; an
+            # explicit --seeds list is printed verbatim (11..42 would
+            # misreport which seeds ran).
+            consecutive = stats.seeds == list(
+                range(stats.seeds[0], stats.seeds[0] + len(stats.seeds))
+            )
+            seed_str = (
+                f"{stats.seeds[0]}..{stats.seeds[-1]}"
+                if consecutive and len(stats.seeds) > 1
+                else ",".join(str(s) for s in stats.seeds)
+            )
+            lines.append(
+                f"{'':<28}final gap {stats.final_gap_mean:.5f} ± "
+                f"{stats.final_gap_std:.5f} over seeds "
+                f"{seed_str}{cons} "
+                "(iters/s = aggregate across replicas)"
+            )
+            continue
         s = rec.summary
         iters = str(s.iterations_to_threshold) if s.iterations_to_threshold > 0 else "never"
         if np.isfinite(s.seconds_to_threshold):

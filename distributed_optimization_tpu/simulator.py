@@ -34,9 +34,15 @@ import numpy as np
 from distributed_optimization_tpu.backends.base import (
     BackendRunResult,
     run_algorithm,
+    run_algorithm_batch,
 )
 from distributed_optimization_tpu.config import ExperimentConfig
-from distributed_optimization_tpu.metrics import NumericalResult, summarize_run
+from distributed_optimization_tpu.metrics import (
+    NumericalResult,
+    ReplicateStats,
+    summarize_replicates,
+    summarize_run,
+)
 from distributed_optimization_tpu.utils.data import (
     HostDataset,
     generate_synthetic_dataset,
@@ -55,13 +61,22 @@ REFERENCE_MATRIX = (
 
 @dataclasses.dataclass
 class ExperimentRecord:
-    """One completed (or skipped) run of the matrix."""
+    """One completed (or skipped) run of the matrix.
+
+    Replica-batched runs (``config.replicas > 1``) additionally carry the
+    full ``BatchRunResult`` and the seed-variance ``ReplicateStats``;
+    ``result``/``summary`` then hold replica 0's trajectory as the
+    representative curve (plots need ONE line per row), while the report
+    and JSON layers quote the mean ± std columns from ``replicate_stats``.
+    """
 
     label: str
     config: Optional[ExperimentConfig]  # None for skipped rows
     result: Optional[BackendRunResult]
     summary: Optional[NumericalResult]
     skipped_reason: Optional[str] = None
+    batch: Optional[object] = None  # jax_backend.BatchRunResult
+    replicate_stats: Optional[ReplicateStats] = None
 
 
 class Simulator:
@@ -107,11 +122,35 @@ class Simulator:
                 if cfg.algorithm == "centralized"
                 else f"{cfg.algorithm} ({cfg.topology})"
             )
+        kwargs = dict(run_kwargs or {})
+        replicated = cfg.replicas > 1 or "seeds" in kwargs or "sweep" in kwargs
         if verbose:
+            rep = (
+                f", replicas={len(kwargs['seeds']) if 'seeds' in kwargs else cfg.replicas}"
+                if replicated else ""
+            )
             print(f"[simulator] running {label!r} "
                   f"(algorithm={cfg.algorithm}, topology={cfg.topology}, "
-                  f"backend={cfg.backend}, T={cfg.n_iterations})", file=sys.stderr)
-        result = run_algorithm(cfg, self.dataset, self.f_opt, **(run_kwargs or {}))
+                  f"backend={cfg.backend}, T={cfg.n_iterations}{rep})",
+                  file=sys.stderr)
+        batch = None
+        stats = None
+        if replicated:
+            # One vmapped program runs every replica (ISSUE-4): the record
+            # keeps replica 0 as the representative trajectory and the
+            # mean ± std statistics alongside.
+            batch = run_algorithm_batch(cfg, self.dataset, self.f_opt, **kwargs)
+            result = batch.results[0]
+            stats = summarize_replicates(
+                batch.objective,
+                batch.consensus_error,
+                result.history.eval_iterations,
+                cfg.suboptimality_threshold,
+                batch.seeds,
+                batch.aggregate_iters_per_second,
+            )
+        else:
+            result = run_algorithm(cfg, self.dataset, self.f_opt, **kwargs)
         summary = summarize_run(
             label,
             result.history,
@@ -119,16 +158,28 @@ class Simulator:
             cfg.n_workers,
             spectral_gap=result.history.spectral_gap,
         )
-        record = ExperimentRecord(label, cfg, result, summary)
+        record = ExperimentRecord(
+            label, cfg, result, summary, batch=batch, replicate_stats=stats
+        )
         self.records.append(record)
         if verbose:
-            gap = result.history.objective[-1]
-            print(
-                f"[simulator] {label!r}: final gap {gap:.5f}, "
-                f"iters-to-threshold {summary.iterations_to_threshold}, "
-                f"{result.history.iters_per_second:.1f} iters/sec",
-                file=sys.stderr,
-            )
+            if stats is not None:
+                print(
+                    f"[simulator] {label!r}: final gap "
+                    f"{stats.final_gap_mean:.5f} ± {stats.final_gap_std:.5f} "
+                    f"over {stats.n_replicas} replicas, "
+                    f"{stats.aggregate_iters_per_second:.1f} aggregate "
+                    "iters/sec",
+                    file=sys.stderr,
+                )
+            else:
+                gap = result.history.objective[-1]
+                print(
+                    f"[simulator] {label!r}: final gap {gap:.5f}, "
+                    f"iters-to-threshold {summary.iterations_to_threshold}, "
+                    f"{result.history.iters_per_second:.1f} iters/sec",
+                    file=sys.stderr,
+                )
         return record
 
     def skip(self, label: str, reason: str) -> ExperimentRecord:
@@ -220,5 +271,35 @@ class Simulator:
                     final_objective_gap=float(rec.result.history.objective[-1]),
                     history=rec.result.history.as_dict(),
                 )
+                if rec.replicate_stats is not None:
+                    s = rec.replicate_stats
+                    it_mean = s.iterations_to_threshold_mean
+                    it_std = s.iterations_to_threshold_std
+                    row["replicates"] = {
+                        "n": s.n_replicas,
+                        "seeds": s.seeds,
+                        "final_gap_mean": s.final_gap_mean,
+                        "final_gap_std": s.final_gap_std,
+                        "consensus_mean": s.consensus_mean,
+                        "consensus_std": s.consensus_std,
+                        # None (not NaN) when no replica reached ε.
+                        "iterations_to_threshold_mean": (
+                            None if np.isnan(it_mean) else it_mean
+                        ),
+                        "iterations_to_threshold_std": (
+                            None if np.isnan(it_std) else it_std
+                        ),
+                        "n_reached": s.n_reached,
+                        "per_replica_iterations": s.per_replica_iterations,
+                        "aggregate_iters_per_second": (
+                            s.aggregate_iters_per_second
+                        ),
+                        "objective_mean": np.mean(
+                            rec.batch.objective, axis=0
+                        ).tolist(),
+                        "objective_std": np.std(
+                            rec.batch.objective, axis=0
+                        ).tolist(),
+                    }
             out["runs"].append(row)
         return out

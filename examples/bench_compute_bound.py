@@ -89,6 +89,40 @@ def main() -> None:
     print(f"[compute_bound] device={dev} peak={PEAK_TFLOPS}TF/s "
           f"{PEAK_GBPS}GB/s", file=sys.stderr)
 
+    # Published-floor pre-flight (round 6 — VERDICT r5 item 2: the 33-36%
+    # MFU number had no protecting assert). The floor lives in the
+    # COMMITTED artifact this bench regenerates, exactly like bench.py's
+    # published_range_ips: read it before any chip work, enforce it after
+    # measuring, and write it back into the new payload so the gate
+    # survives regeneration. Loosening it is a committed, deliberate act.
+    prev_path = Path(args.out)
+    skip_gate = os.environ.get("BENCH_NO_RANGE_CHECK", "").lower() not in (
+        "", "0", "false"
+    )
+    mfu_floor = None
+    if prev_path.exists():
+        prev = json.loads(prev_path.read_text())
+        mfu_floor = prev.get("published_mfu_floor")
+        if mfu_floor is None and not skip_gate:
+            raise SystemExit(
+                f"{prev_path} exists but carries no published_mfu_floor — "
+                "the compute-bound tier must stay gated; add the floor "
+                "(best bf16 cell's median MFU with honest margin) before "
+                "regenerating, or set BENCH_NO_RANGE_CHECK=1 on "
+                "non-canonical hardware"
+            )
+    if mfu_floor is None:
+        # Bootstrap (fresh --out path or escape hatch): the regenerated
+        # artifact will carry published_mfu_floor: null, i.e. an UNGATED
+        # tier — say so loudly rather than disarming the gate silently.
+        print(
+            "[compute_bound] WARNING: no published_mfu_floor available — "
+            "this run is ungated and the written artifact will carry "
+            "published_mfu_floor: null; set a floor in the committed "
+            "artifact to restore the regression gate",
+            file=sys.stderr,
+        )
+
     N, K, b = 8, 512, 2048
     T = args.iters
     # (label, d_feat, dtype, matmul_precision). 'highest' is the framework
@@ -171,8 +205,35 @@ def main() -> None:
               f"{row['min_hbm_gbps']:5.0f} GB/s "
               f"({row['hbm_util_lower_bound'] * 100:.0f}%)", file=sys.stderr)
 
+    # --- published-floor gate (the compute tier's bench-regression gate;
+    # BENCH_NO_RANGE_CHECK = bench.py's non-canonical-hardware escape:
+    # on another chip generation or a CPU container an out-of-floor MFU
+    # means "different machine", not a regression) ---
+    best_mfu = max(r["mfu_vs_bf16_peak"] for r in results.values())
+    if skip_gate:
+        print(
+            "[compute_bound] BENCH_NO_RANGE_CHECK set: skipping the "
+            "published MFU-floor gate (non-canonical hardware mode)",
+            file=sys.stderr,
+        )
+    elif mfu_floor is not None and best_mfu < mfu_floor:
+        raise SystemExit(
+            f"best-cell MFU {best_mfu:.3f} is below the published floor "
+            f"{mfu_floor} ({prev_path.name}) — the compute-bound tier "
+            "regressed (or this is non-canonical hardware: set "
+            "BENCH_NO_RANGE_CHECK=1). Re-derive the floor in a commit if "
+            "the regression is real and explained."
+        )
+    elif mfu_floor is not None:
+        print(
+            f"[compute_bound] MFU gate OK: best cell {best_mfu:.3f} >= "
+            f"published floor {mfu_floor}",
+            file=sys.stderr,
+        )
+
     payload = {
         "device": str(dev),
+        "published_mfu_floor": mfu_floor,
         "peak_tflops_bf16": PEAK_TFLOPS,
         "peak_hbm_gbps": PEAK_GBPS,
         "workload": (

@@ -22,8 +22,9 @@ python examples/bench_churn.py             # -> docs/perf/churn.json
 python examples/bench_byzantine.py         # -> docs/perf/byzantine.json
 python examples/bench_robust_scale.py      # -> docs/perf/robust_scale.json
 python examples/bench_sparse_mixing.py     # -> docs/perf/sparse_mixing.json
-python examples/bench_compute_bound.py     # -> docs/perf/compute_bound.json
+python examples/bench_compute_bound.py     # -> docs/perf/compute_bound.json (MFU-floor gated)
 python examples/bench_eval_cadence.py      # -> docs/perf/eval_cadence.json
+python examples/bench_sweep.py             # -> docs/perf/sweep.json (replica-batch floor gated)
 python examples/reproduce_report.py --json docs/perf/report_reproduction.json
 python examples/northstar_consensus.py --ring-full  # -> docs/perf/northstar_consensus.json
 python bench.py                            # headline JSON line (stdout)

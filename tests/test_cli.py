@@ -176,3 +176,71 @@ def test_preset_softmax_mxu(tmp_path):
                "--n-informative-features", "16", "--n-classes", "8",
                "--n-samples", "512", "--quiet"])
     assert rc == 0
+
+
+def test_replicas_and_seeds_flags(tmp_path):
+    # --replicas / --topology-seed round-trip into the config.
+    args = build_parser().parse_args(
+        ["--replicas", "4", "--topology-seed", "7"]
+    )
+    cfg = config_from_args(args)
+    assert (cfg.replicas, cfg.topology_seed) == (4, 7)
+    assert cfg.resolved_topology_seed() == 7
+    # --tp round-trips for the supported softmax combination; the default
+    # (logistic) config rejects tp>1 at construction with the reason.
+    args_tp = build_parser().parse_args(
+        ["--tp", "2", "--problem-type", "softmax", "--n-classes", "4",
+         "--local-batch-size", "100000"]
+    )
+    assert config_from_args(args_tp).tp_degree == 2
+    import pytest
+
+    with pytest.raises(ValueError, match="softmax"):
+        # tp>1 + logistic is rejected through config validation.
+        main(_TINY + ["--tp", "2"])
+
+    # End-to-end replicated run: mean ± std lands in the JSON.
+    json_out = tmp_path / "rep.json"
+    rc = main(_TINY + ["--algorithm", "dsgd", "--topology", "ring",
+                       "--replicas", "3", "--json", str(json_out)])
+    assert rc == 0
+    blob = json.loads(json_out.read_text())
+    rep = blob["runs"][0]["replicates"]
+    assert rep["n"] == 3 and rep["seeds"] == [203, 204, 205]
+
+    # Explicit --seeds list defines the replica axis verbatim.
+    json_out2 = tmp_path / "seeds.json"
+    rc = main(_TINY + ["--algorithm", "dsgd", "--topology", "ring",
+                       "--seeds", "11,99,42", "--json", str(json_out2)])
+    assert rc == 0
+    rep2 = json.loads(json_out2.read_text())["runs"][0]["replicates"]
+    assert rep2["seeds"] == [11, 99, 42]
+
+
+def test_replicas_conflicts_rejected(tmp_path):
+    import pytest
+
+    with pytest.raises(SystemExit, match="checkpoint"):
+        main(_TINY + ["--replicas", "2",
+                      "--checkpoint-dir", str(tmp_path / "ck")])
+    with pytest.raises(SystemExit, match="measure-time"):
+        main(_TINY + ["--seeds", "1,2", "--measure-time"])
+    with pytest.raises(SystemExit, match="integer"):
+        main(_TINY + ["--seeds", "1,x"])
+
+
+def test_tp_cli_runs_on_virtual_mesh(tmp_path):
+    # The round-5 tensor-parallel path through the config/CLI surface:
+    # softmax + dsgd + ring + full local batches on the 8-device mesh.
+    json_out = tmp_path / "tp.json"
+    rc = main([
+        "--problem-type", "softmax", "--n-classes", "4", "--algorithm",
+        "dsgd", "--topology", "ring", "--n-workers", "4", "--n-samples",
+        "128", "--n-features", "12", "--n-informative-features", "6",
+        "--local-batch-size", "64", "--n-iterations", "40", "--eval-every",
+        "20", "--tp", "2", "--quiet", "--json", str(json_out),
+    ])
+    assert rc == 0
+    blob = json.loads(json_out.read_text())
+    gaps = blob["runs"][0]["history"]["objective"]
+    assert len(gaps) == 2 and np.isfinite(gaps).all()
