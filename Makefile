@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test smoke bench-byzantine bench-churn bench-robust-scale \
-	bench-sweep bench-compute
+	bench-sweep bench-compute bench-telemetry
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -12,12 +12,13 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
 # Fast robustness smoke: fault-injection + churn + Byzantine + gather-
-# aggregation + replica-batched-parity suites, first failure stops,
-# strict collection (no marker typos, no swallowed import errors).
+# aggregation + replica-batched-parity + telemetry suites, first failure
+# stops, strict collection (no marker typos, no swallowed import errors).
 smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest -q -m 'not slow' -x \
 		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py \
-		tests/test_robust_gather.py tests/test_batch.py
+		tests/test_robust_gather.py tests/test_batch.py \
+		tests/test_telemetry.py
 
 # Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
 bench-byzantine:
@@ -45,3 +46,9 @@ bench-sweep:
 # chip — on CPU containers set BENCH_NO_RANGE_CHECK=1).
 bench-compute:
 	$(PY) examples/bench_compute_bound.py
+
+# Regenerate the flight-recorder overhead evidence
+# (docs/perf/telemetry.json: telemetry off vs on, asserted <=10%
+# steady-state ceiling + bitwise off/on trajectory gate).
+bench-telemetry:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_telemetry.py

@@ -43,10 +43,13 @@ from distributed_optimization_tpu.ops.sampling import (
     sample_worker_batches,
 )
 from distributed_optimization_tpu.ops.robust_aggregation import (
+    make_gather_robust_activity,
     make_gather_robust_aggregator,
+    make_robust_activity,
     make_robust_aggregator,
     validate_budget,
 )
+from distributed_optimization_tpu.telemetry import cost_from_lowered
 from distributed_optimization_tpu.parallel.adversary import (
     make_adversary,
     make_byzantine_mixing,
@@ -154,6 +157,15 @@ class _StepPieces:
     collect_metrics: bool
     track_consensus: bool
     edge_payload: object
+    # --- flight recorder (config.telemetry; telemetry.TRACE_FIELDS) ---
+    telemetry: bool = False
+    # ``activity(t, x) -> scalar``: robust-aggregation screening fraction
+    # over the realized graph at t (corruption composed upstream, like the
+    # aggregate itself); None when no robust rule is active.
+    robust_activity: object = None
+    # Nominal Σ_i deg_i of the static topology (the fault-free live_edges
+    # row; 0.0 for centralized runs).
+    static_degree_sum: float = 0.0
 
 
 def _make_step_eval(p: _StepPieces, data):
@@ -275,8 +287,78 @@ def _make_step_eval(p: _StepPieces, data):
             )
         return new_state, None
 
-    def eval_metrics(state):
+    def trace_row(state, t):
+        """One flight-recorder row (telemetry.TRACE_FIELDS) at iteration t:
+        pure observability computed from the post-step state, feeding the
+        scan's stacked OUTPUTS only — the carry and the step dataflow are
+        untouched, so trajectories are bitwise-identical with telemetry on
+        or off (tests/test_telemetry.py pins it). The gradient uses the
+        same (key, t) batch realization the iteration-t step consumed."""
+        x = state["x"]
+        acc = jnp.promote_types(jnp.float32, x.dtype)
+        g = grad_fn_factory(t)(x, 0).astype(acc)
+        nonfinite = jnp.zeros((), dtype=jnp.float32)
+        for leaf in jax.tree.leaves(state):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                nonfinite = nonfinite + jnp.sum(
+                    ~jnp.isfinite(leaf)
+                ).astype(jnp.float32)
+        if faulty is not None:
+            nodes_up = faulty.active(t)
+            live_edges = faulty.realized_degree_sum(t).astype(jnp.float32)
+        else:
+            nodes_up = jnp.ones(x.shape[0], dtype=jnp.float32)
+            live_edges = jnp.asarray(p.static_degree_sum, dtype=jnp.float32)
+        clip_frac = (
+            p.robust_activity(t, x).astype(jnp.float32)
+            if p.robust_activity is not None
+            else jnp.zeros((), dtype=jnp.float32)
+        )
+        return {
+            "grad_norm": jnp.sqrt(jnp.sum(g * g, axis=-1)).astype(
+                jnp.float32
+            ),
+            "param_norm": jnp.sqrt(
+                jnp.sum(x.astype(acc) ** 2, axis=-1)
+            ).astype(jnp.float32),
+            "nodes_up": nodes_up,
+            "nonfinite": nonfinite,
+            "live_edges": live_edges,
+            "clip_frac": clip_frac,
+        }
+
+    def _zero_trace(state):
+        n = state["x"].shape[0]
+        z = jnp.zeros((), dtype=jnp.float32)
+        zn = jnp.zeros(n, dtype=jnp.float32)
+        return {
+            "grad_norm": zn, "param_norm": zn, "nodes_up": zn,
+            "nonfinite": z, "live_edges": z, "clip_frac": z,
+        }
+
+    def eval_metrics(state, t_last, cadence_known=False):
+        """Per-eval metrics + flight-recorder row at iteration ``t_last``.
+
+        ``cadence_known=True`` promises t_last IS an eval boundary (the
+        chunked/hoisted forms); the inline fused scan computes its eval
+        every trip and discards off-cadence rows, so there the trace row —
+        whose gradient probe is NOT latency-hidden the way the stacked-
+        output eval is — hides behind a ``lax.cond`` on the boundary
+        predicate instead of running every trip (measured 36% → <10%
+        steady overhead on the CPU container; docs/perf/telemetry.json).
+        """
         out = {}
+        if p.telemetry:
+            if cadence_known:
+                out["trace"] = trace_row(state, t_last)
+            else:
+                on_boundary = (t_last + 1) % p.config.eval_every == 0
+                out["trace"] = jax.lax.cond(
+                    on_boundary,
+                    lambda s: trace_row(s, t_last),
+                    _zero_trace,
+                    state,
+                )
         if p.collect_metrics:
             x = state["x"]
             if adversary is not None:
@@ -390,17 +472,20 @@ def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
 def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
                     byz=None, noise_key=None):
     """Byzantine adversary + robust-aggregation wiring shared by ``_run``
-    and ``run_batch`` (docs/BYZANTINE.md). Returns ``(adversary,
-    byz_mix)`` — both None when the config is benign. The keyword
-    overrides are the replica-batched hooks: ``clip_tau`` a per-replica
-    (possibly traced) radius, ``byz``/``noise_key`` the per-replica
-    Byzantine set and large-noise stream.
+    and ``run_batch`` (docs/BYZANTINE.md). Returns ``(adversary, byz_mix,
+    activity_t)`` — all None when the config is benign. ``activity_t(t, x)``
+    is the flight recorder's screening-fraction probe (the telemetry twin
+    of the robust rule, over the same realized graph and the same
+    corrupted stack; None without a robust rule). The keyword overrides
+    are the replica-batched hooks: ``clip_tau`` a per-replica (possibly
+    traced) radius, ``byz``/``noise_key`` the per-replica Byzantine set
+    and large-noise stream.
     """
     byzantine_active = config.attack != "none" or (
         config.aggregation != "gossip" and config.robust_b > 0
     )
     if not byzantine_active:
-        return None, None
+        return None, None, None
     if not algo.supports_byzantine:
         raise ValueError(
             f"Byzantine injection / robust aggregation is "
@@ -418,6 +503,7 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
         config.attack_scale, config.seed, byz=byz, noise_key=noise_key,
     )
     robust_aggregate_t = None
+    activity_src = None
     if config.aggregation != "gossip" and config.robust_b > 0:
         validate_budget(
             int(topo.degrees.min()), config.robust_b,
@@ -455,6 +541,12 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
             robust_aggregate_t = (
                 lambda t, v: gather_agg(live_fn(t), v)  # noqa: E731
             )
+            gather_act = make_gather_robust_activity(
+                config.aggregation, config.robust_b, nbr_idx, ct,
+            )
+            activity_src = (
+                lambda t, v: gather_act(live_fn(t), v)  # noqa: E731
+            )
         else:
             dense_agg = make_robust_aggregator(
                 config.aggregation, config.robust_b, ct
@@ -469,6 +561,12 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
             robust_aggregate_t = (
                 lambda t, v: dense_agg(adj_fn(t), v)  # noqa: E731
             )
+            dense_act = make_robust_activity(
+                config.aggregation, config.robust_b, ct
+            )
+            activity_src = (
+                lambda t, v: dense_act(adj_fn(t), v)  # noqa: E731
+            )
     if faulty is not None:
         base_mix_t = faulty.mix
     else:
@@ -476,7 +574,17 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
     byz_mix = make_byzantine_mixing(
         adversary, base_mix_t, aggregate_t=robust_aggregate_t,
     )
-    return adversary, byz_mix
+    activity_t = None
+    if activity_src is not None:
+        # The probe sees exactly what the screening rule sees: the stack
+        # AS TRANSMITTED (attack payloads applied) over the realized graph.
+        if adversary is not None:
+            activity_t = (
+                lambda t, v: activity_src(t, adversary.corrupt(t, v))  # noqa: E731
+            )
+        else:
+            activity_t = activity_src
+    return adversary, byz_mix, activity_t
 
 
 def _run_chunked(
@@ -495,9 +603,11 @@ def _run_chunked(
     timestamp — the measured wall-clock the reference samples per iteration
     (trainer.py:63,181), at eval granularity. Returns (final_state, gap_hist,
     cons_hist, time_hist, realized_floats, executed_iters, compile_seconds,
-    run_seconds) — ``executed_iters`` counts only iterations run in THIS
-    process, so resumed runs report honest throughput; ``time_hist`` is
-    cumulative across installments (restored timestamps carry an offset).
+    run_seconds, trace, cost) — ``executed_iters`` counts only iterations
+    run in THIS process, so resumed runs report honest throughput;
+    ``time_hist`` is cumulative across installments (restored timestamps
+    carry an offset); ``trace``/``cost`` are the flight-recorder buffers
+    and XLA cost analysis (None when ``config.telemetry`` is off).
     """
     from distributed_optimization_tpu.parallel.mesh import (
         replicate as _replicate,
@@ -519,7 +629,9 @@ def _run_chunked(
 
     t0 = time.perf_counter()
     with jax.default_matmul_precision(config.matmul_precision):
-        compiled = jax.jit(chunk).lower(state0, ts_row0, data_args).compile()
+        lowered = jax.jit(chunk).lower(state0, ts_row0, data_args)
+        cost = cost_from_lowered(lowered) if config.telemetry else None
+        compiled = lowered.compile()
     compile_seconds = time.perf_counter() - t0 if measure_compile else 0.0
 
     state = state0
@@ -527,6 +639,7 @@ def _run_chunked(
     cons_list: list[float] = []
     floats_list: list[float] = []
     time_list: list[float] = []
+    trace_lists: dict[str, list] = {}
     start_chunk = 0
     if ckptr is not None and checkpoint.resume:
         restored = ckptr.restore()
@@ -560,6 +673,9 @@ def _run_chunked(
             cons_list.append(float(out["cons"]))
         if "floats" in out:
             floats_list.append(float(out["floats"]))
+        if "trace" in out:
+            for k, v in out["trace"].items():
+                trace_lists.setdefault(k, []).append(np.asarray(v))
         # The metric fetches above already forced the chunk to completion;
         # sync explicitly anyway so the timestamp is honest when metrics
         # collection is off. Earlier saves' durations are subtracted — they
@@ -584,8 +700,12 @@ def _run_chunked(
     time_hist = np.asarray(time_list, dtype=np.float64)
     realized_floats = float(np.sum(floats_list)) if floats_list else None
     executed_iters = (n_evals - start_chunk) * eval_every
+    trace = (
+        {k: np.stack(v) for k, v in trace_lists.items()}
+        if trace_lists else None
+    )
     return (state, gap_hist, cons_hist, time_hist, realized_floats,
-            executed_iters, compile_seconds, run_seconds)
+            executed_iters, compile_seconds, run_seconds, trace, cost)
 
 
 def _run_segmented_fused(
@@ -682,7 +802,7 @@ def _run_segmented_fused(
             mesh, jnp.asarray(done * eval_every, dtype=jnp.int32)
         )
         state, ys = compiled_by_size[this_evals](state, t0_iter, data_args)
-        gap, cons, floats = harvest(ys, this_evals)
+        gap, cons, floats, _ = harvest(ys, this_evals)
         if gap is not None:
             gap_list.extend(gap.tolist())
         if cons is not None:
@@ -859,6 +979,12 @@ def _run(
     instead use the host-driven chunk loop with real per-eval timestamps,
     at its measured 2.2× coarse-cadence cost (docs/PERF.md §root-cause).
     """
+    if config.telemetry and checkpoint is not None:
+        raise ValueError(
+            "telemetry trace buffers are not checkpointed: a resumed run "
+            "would silently emit a truncated trace — record telemetry "
+            "without checkpointing, or checkpoint without telemetry"
+        )
     algo = get_algorithm(config.algorithm)
     problem = get_problem(
         config.problem_type, huber_delta=config.huber_delta,
@@ -941,9 +1067,10 @@ def _run(
         # keeps the plain gossip path bitwise (a robust rule degrades to
         # MH gossip at zero budget by definition).
         faulty = _build_faulty(config, algo, topo, T)
-        adversary, byz_mix = _bind_byzantine(
+        adversary, byz_mix, robust_activity = _bind_byzantine(
             config, algo, topo, faulty, mix_op
         )
+        static_degree_sum = float(np.asarray(topo.adjacency).sum())
     else:
         if (
             config.edge_drop_prob > 0.0
@@ -962,6 +1089,8 @@ def _run(
         byzantine_active = False
         adversary = None
         byz_mix = None
+        robust_activity = None
+        static_degree_sum = 0.0
         topo = None
         mix_op = None
         faulty = None
@@ -1062,6 +1191,8 @@ def _run(
         fused_mix_step=fused_mix_step, full_objective=full_objective,
         f_opt=f_opt, collect_metrics=collect_metrics,
         track_consensus=track_consensus, edge_payload=edge_payload,
+        telemetry=config.telemetry, robust_activity=robust_activity,
+        static_degree_sum=static_degree_sum,
     )
 
     def make_step_eval(data):
@@ -1077,7 +1208,7 @@ def _run(
 
         def chunk(state, ts):
             state, _ = jax.lax.scan(step, state, ts, unroll=inner_unroll)
-            out = eval_metrics(state)
+            out = eval_metrics(state, ts[-1], cadence_known=True)
             if faulty is not None:
                 out["floats"] = floats_for(ts)
             return state, out
@@ -1174,7 +1305,9 @@ def _run(
             def microchunk(state, ts_row):
                 for j in range(micro):
                     state, _ = step(state, ts_row[j])
-                out = eval_metrics(state) if collect_metrics else {}
+                out = eval_metrics(
+                    state, ts_row[-1], cadence_known=trips_per_eval == 1
+                )
                 if faulty is not None:
                     out["floats"] = floats_for(ts_row)
                 return state, out
@@ -1207,7 +1340,9 @@ def _run(
                     state, _ = jax.lax.scan(
                         micro_only, state, ts, unroll=flat_unroll
                     )
-                    out = eval_metrics(state)
+                    out = eval_metrics(
+                        state, ts.reshape(-1)[-1], cadence_known=True
+                    )
                     if faulty is not None:
                         out["floats"] = floats_for(ts.reshape(-1))
                     outs.append(out)
@@ -1233,7 +1368,9 @@ def _run(
         def _harvest_inline(ys, n_rows_evals):
             """On-cadence metric rows from a scan's stacked outputs (the
             off-cadence rows hold real inline-computed evals the requested
-            cadence discards); faults' realized floats summed per eval."""
+            cadence discards); faults' realized floats summed per eval.
+            Trace-buffer rows select like the gap: the eval-boundary trip's
+            row is the recorded one."""
             sel = slice(trips_per_eval - 1, None, trips_per_eval)
             gap = (
                 np.asarray(ys["gap"][sel], dtype=np.float64)
@@ -1248,7 +1385,11 @@ def _run(
                 .reshape(n_rows_evals, trips_per_eval).sum(axis=1)
                 if "floats" in ys else None
             )
-            return gap, cons, floats
+            trace = (
+                {k: np.asarray(v)[sel] for k, v in ys["trace"].items()}
+                if "trace" in ys else None
+            )
+            return gap, cons, floats, trace
 
         def _harvest_hoisted(ys, n_rows_evals):
             """Hoisted rows are already exactly per-eval."""
@@ -1259,6 +1400,8 @@ def _run(
                 if "cons" in ys else None,
                 np.asarray(ys["floats"], dtype=np.float64)
                 if "floats" in ys else None,
+                {k: np.asarray(v) for k, v in ys["trace"].items()}
+                if "trace" in ys else None,
             )
 
         make_seg_scan = (
@@ -1275,7 +1418,11 @@ def _run(
             # separable (jax.profiler-style phase split, SURVEY.md §5.1).
             t0 = time.perf_counter()
             with jax.default_matmul_precision(config.matmul_precision):
-                compiled = jax.jit(run_scan).lower(state0, data_args).compile()
+                lowered = jax.jit(run_scan).lower(state0, data_args)
+                cost = (
+                    cost_from_lowered(lowered) if config.telemetry else None
+                )
+                compiled = lowered.compile()
             compile_seconds = (
                 time.perf_counter() - t0 if measure_compile else 0.0
             )
@@ -1286,7 +1433,9 @@ def _run(
             run_seconds = time.perf_counter() - t1
             executed_iters = T
 
-            gap_hist, cons_hist, floats_per_eval = _harvest(ys, n_evals)
+            gap_hist, cons_hist, floats_per_eval, trace = _harvest(
+                ys, n_evals
+            )
             if gap_hist is None:
                 gap_hist = np.full(n_evals, np.nan)
             realized_floats = (
@@ -1301,6 +1450,10 @@ def _run(
                 run_seconds / max(n_evals, 1), run_seconds, n_evals
             )
         else:
+            # Telemetry + checkpoint is rejected above, so the segmented
+            # path never carries trace buffers or cost analysis.
+            cost = None
+            trace = None
             (final_state, gap_hist, cons_hist, time_hist, realized_floats,
              executed_iters, compile_seconds, run_seconds) = (
                 _run_segmented_fused(
@@ -1318,9 +1471,11 @@ def _run(
             return make_chunk(data)(state, ts)
 
         (final_state, gap_hist, cons_hist, time_hist, realized_floats,
-         executed_iters, compile_seconds, run_seconds) = _run_chunked(
-            chunk_fn, state0, data_args, checkpoint, mesh, config, n_evals,
-            measure_compile,
+         executed_iters, compile_seconds, run_seconds, trace, cost) = (
+            _run_chunked(
+                chunk_fn, state0, data_args, checkpoint, mesh, config,
+                n_evals, measure_compile,
+            )
         )
         time_measured = True
         if not collect_metrics:
@@ -1355,6 +1510,8 @@ def _run(
         ),
         compile_seconds=compile_seconds,
         spectral_gap=spectral_gap,
+        trace=trace,
+        cost=cost,
     )
     return BackendRunResult(
         history=history,
@@ -1736,12 +1893,17 @@ def _run_batch(
     )
     n_trips = n_evals * trips_per_eval
 
+    static_degree_sum = (
+        float(np.asarray(topo.adjacency).sum()) if topo is not None else 0.0
+    )
+
     def replica_scan(rp_r, state_init, t0_dev, data):
         """One replica's flat fused scan — the sequential program, traced
         with this replica's randomness/scalars bound from ``rp_r``."""
         faulty = None
         adversary = None
         byz_mix = None
+        robust_activity = None
         honest_w = None
         if algo.is_decentralized:
             tl = None
@@ -1764,7 +1926,7 @@ def _run_batch(
                     ),
                     timeline=tl, horizon=horizon,
                 )
-            adversary, byz_mix = _bind_byzantine(
+            adversary, byz_mix, robust_activity = _bind_byzantine(
                 config, algo, topo, faulty, mix_op,
                 clip_tau=rp_r.get("clip_tau"),
                 byz=rp_r.get("byz"),
@@ -1784,13 +1946,17 @@ def _run_batch(
             fused_mix_step=None, full_objective=full_objective,
             f_opt=f_opt, collect_metrics=collect_metrics,
             track_consensus=track_consensus, edge_payload=edge_payload,
+            telemetry=config.telemetry, robust_activity=robust_activity,
+            static_degree_sum=static_degree_sum,
         )
         step, eval_metrics, floats_for = _make_step_eval(pieces, data)
 
         def microchunk(state, ts_row):
             for j in range(micro):
                 state, _ = step(state, ts_row[j])
-            out = eval_metrics(state) if collect_metrics else {}
+            out = eval_metrics(
+                state, ts_row[-1], cadence_known=trips_per_eval == 1
+            )
             if faulty is not None:
                 out["floats"] = floats_for(ts_row)
             return state, out
@@ -1806,11 +1972,17 @@ def _run_batch(
 
     t_c = time.perf_counter()
     with jax.default_matmul_precision(config.matmul_precision):
-        compiled = (
-            jax.jit(batched)
-            .lower(rp, state0_R, t0_dev, data_args)
-            .compile()
-        )
+        lowered = jax.jit(batched).lower(rp, state0_R, t0_dev, data_args)
+        cost = cost_from_lowered(lowered) if config.telemetry else None
+        if cost is not None:
+            # The analysis covers the WHOLE R-replica vmapped program; the
+            # same dict is attached to every per-replica history, so record
+            # the replica count rather than letting a consumer read R runs'
+            # FLOPs as one run's (divide by program_replicas for an
+            # approximate per-replica share — shared data reads make an
+            # exact split ill-defined).
+            cost = {**cost, "program_replicas": float(R)}
+        compiled = lowered.compile()
     compile_seconds = time.perf_counter() - t_c if measure_compile else 0.0
 
     t_r = time.perf_counter()
@@ -1832,6 +2004,12 @@ def _run_batch(
         np.asarray(ys["floats"], dtype=np.float64)
         .reshape(R, n_evals, trips_per_eval).sum(axis=2)
         if "floats" in ys else None
+    )
+    # Trace-buffer rows select like the gap (eval-boundary trips), with the
+    # replica axis leading: [R, n_evals] scalars / [R, n_evals, N] rows.
+    trace_R = (
+        {k: np.asarray(v)[:, sel] for k, v in ys["trace"].items()}
+        if "trace" in ys else None
     )
     objective = gap if gap is not None else np.full((R, n_evals), np.nan)
 
@@ -1867,6 +2045,11 @@ def _run_batch(
             iters_per_second=aggregate_ips / R,
             compile_seconds=compile_seconds,
             spectral_gap=spectral_gap,
+            trace=(
+                {k: v[r] for k, v in trace_R.items()}
+                if trace_R is not None else None
+            ),
+            cost=cost,
         )
         models_r = final_models[r]
         if byz_hosts is not None:

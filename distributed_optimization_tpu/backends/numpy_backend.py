@@ -48,6 +48,7 @@ from distributed_optimization_tpu.metrics import (
 )
 from distributed_optimization_tpu.ops import losses_np
 from distributed_optimization_tpu.ops.robust_aggregation import (
+    robust_activity_np,
     robust_aggregate_np,
     validate_budget,
 )
@@ -318,12 +319,20 @@ def run(
             return np.empty(0, dtype=np.int64)
         return rng.choice(ni, size=b, replace=False)
 
+    # Last-drawn batch indices per worker — the flight recorder's gradient
+    # probe reuses them, so it measures the SAME batch realization the eval
+    # iteration's step consumed (jax_backend parity: its probe re-derives
+    # that batch from the counter-based (key, t)) WITHOUT consuming any
+    # extra host-RNG draws — telemetry must not perturb the trajectory.
+    last_idx: dict[int, np.ndarray] = {}
+
     def make_grad(t: int):
         def grad(params: np.ndarray, slot: int) -> np.ndarray:
             out = np.zeros((n, d))
             for i in range(n):
                 Xi, yi = shards[i]
                 idx = sample_indices(t, i)
+                last_idx[i] = idx
                 out[i] = gradient(params[i], Xi[idx], yi[idx], reg)
             return out
 
@@ -464,6 +473,54 @@ def run(
     gap_hist = np.full(n_evals, np.nan)
     cons_hist = np.full(n_evals, np.nan)
     time_hist = np.empty(n_evals)
+    trace_lists: Optional[dict[str, list]] = (
+        {k: [] for k in ("grad_norm", "param_norm", "nodes_up",
+                         "nonfinite", "live_edges", "clip_frac")}
+        if config.telemetry else None
+    )
+
+    def trace_row(x: np.ndarray, t: int) -> None:
+        """One flight-recorder row (telemetry.TRACE_FIELDS) — independent
+        float64 twin of the jax backend's in-scan probe, same keys/shapes/
+        float32 rows, recorded from the post-step state at the eval
+        boundary."""
+        gnorm = np.zeros(n)
+        for i in range(n):
+            Xi, yi = shards[i]
+            idx = last_idx.get(i)
+            if idx is None:  # no step ran yet (T == 0 edge)
+                idx = np.arange(shard_sizes[i])
+            gnorm[i] = np.linalg.norm(gradient(x[i], Xi[idx], yi[idx], reg))
+        nonf = 0
+        for v in state.values():
+            if isinstance(v, np.ndarray) and np.issubdtype(
+                v.dtype, np.floating
+            ):
+                nonf += int(np.sum(~np.isfinite(v)))
+        if algo.is_decentralized:
+            live_edges = float(np.asarray(live["A"]).sum())
+        else:
+            live_edges = 0.0
+        nodes = (
+            timeline.node_up[t].astype(np.float32)
+            if timeline is not None and timeline.node_up is not None
+            else np.ones(n, dtype=np.float32)
+        )
+        cf = 0.0
+        if byz is not None and robust_name is not None:
+            cf = robust_activity_np(
+                robust_name, live["A"], corrupt_np(x), config.robust_b,
+                config.clip_tau,
+            )
+        trace_lists["grad_norm"].append(gnorm.astype(np.float32))
+        trace_lists["param_norm"].append(
+            np.linalg.norm(x, axis=1).astype(np.float32)
+        )
+        trace_lists["nodes_up"].append(nodes)
+        trace_lists["nonfinite"].append(np.float32(nonf))
+        trace_lists["live_edges"].append(np.float32(live_edges))
+        trace_lists["clip_frac"].append(np.float32(cf))
+
     start = time.perf_counter()
 
     for t in range(T):
@@ -539,9 +596,18 @@ def run(
                         if byz is not None
                         else consensus_error(x)
                     )
+            if trace_lists is not None:
+                trace_row(x, t)
             time_hist[k] = time.perf_counter() - start
 
     run_seconds = time.perf_counter() - start
+
+    trace = None
+    if trace_lists is not None:
+        trace = {
+            k: np.asarray(v, dtype=np.float32)
+            for k, v in trace_lists.items()
+        }
 
     history = RunHistory(
         objective=gap_hist,
@@ -559,6 +625,7 @@ def run(
         ),
         iters_per_second=T / run_seconds if run_seconds > 0 else float("inf"),
         spectral_gap=spectral_gap,
+        trace=trace,
     )
     final = state["x"]
     return BackendRunResult(

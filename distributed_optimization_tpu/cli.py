@@ -23,9 +23,10 @@ from __future__ import annotations
 
 import argparse
 import json
-import sys
 from typing import Optional, Sequence
 
+from distributed_optimization_tpu.log import configure as configure_logging
+from distributed_optimization_tpu.log import get_logger
 from distributed_optimization_tpu.config import (
     AGGREGATIONS,
     ALGORITHMS,
@@ -39,6 +40,7 @@ from distributed_optimization_tpu.config import (
 )
 
 _DEFAULTS = ExperimentConfig()
+_log = get_logger("cli")
 
 # The five target configurations named in BASELINE.json, as CLI presets.
 # Flags given alongside --preset still override individual fields.
@@ -335,15 +337,26 @@ def build_parser() -> argparse.ArgumentParser:
                       help="enable jax_debug_nans: raise at the first "
                            "NaN-producing op instead of finishing with NaNs")
     diag.add_argument("--preflight", action="store_true",
-                      help="verify mesh collectives (ppermute round-trip, "
-                           "psum identity) before running")
+                      help="run the named preflight identities before the "
+                           "main experiment — collective wiring (ppermute "
+                           "round-trip, psum identity) and jit determinism "
+                           "— failing loudly with the broken identity "
+                           "named (utils/diagnostics.PREFLIGHT_CHECKS)")
+    diag.add_argument("--telemetry", metavar="OUT", default=None,
+                      help="enable the flight recorder (in-scan trace "
+                           "buffers + cost analysis; docs/OBSERVABILITY.md) "
+                           "and write one schema-versioned RunTrace "
+                           "manifest per run to OUT as JSONL")
 
     out = p.add_argument_group("output")
     out.add_argument("--plot", metavar="PATH", default=None,
                      help="save the 2-panel log-scale figure to PATH")
     out.add_argument("--json", metavar="PATH", default=None,
                      help="dump all run histories + summaries as JSON")
-    out.add_argument("--quiet", action="store_true")
+    out.add_argument("-q", "--quiet", action="store_true",
+                     help="log warnings only (package log level WARNING)")
+    out.add_argument("-v", "--verbose", action="store_true",
+                     help="debug-level package logging")
     return p
 
 
@@ -398,12 +411,17 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         scan_unroll=args.scan_unroll,
         dtype=args.dtype,
         matmul_precision=args.matmul_precision,
+        telemetry=getattr(args, "telemetry", None) is not None,
     )
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    # --verbose/-q map to package log levels (log.py; ISSUE-5 satellite):
+    # WARNING under -q, DEBUG under -v, INFO otherwise.
+    configure_logging(1 if args.verbose else (-1 if args.quiet else 0))
 
     if args.preset is not None:
         # Preset values apply only to flags the user did not pass. Detection
@@ -447,12 +465,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "elsewhere set JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / "
                 "JAX_PROCESS_ID, or omit --multihost on a single host."
             ) from e
-        if not args.quiet:
-            print(
-                f"[cli] multihost: process {jax.process_index()} of "
-                f"{jax.process_count()}, {len(jax.devices())} global devices",
-                file=sys.stderr,
-            )
+        _log.info(
+            "multihost: process %d of %d, %d global devices",
+            jax.process_index(), jax.process_count(), len(jax.devices()),
+        )
 
     # Grid in the suite is skipped gracefully for non-square N, but a single
     # run with an invalid combination should fail fast in config validation.
@@ -506,6 +522,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.checkpoint_dir:
         if args.backend != "jax":
             raise SystemExit("--checkpoint-dir requires --backend jax")
+        if args.telemetry:
+            raise SystemExit(
+                "--telemetry does not compose with --checkpoint-dir: trace "
+                "buffers are not checkpointed, so a resumed run would emit "
+                "a truncated manifest"
+            )
         from distributed_optimization_tpu.utils.checkpoint import CheckpointOptions
 
         run_kwargs["checkpoint"] = CheckpointOptions(
@@ -520,19 +542,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # Warn, don't reject: scripts that toggle the flag across
             # backends shouldn't hard-fail on the always-measured ones
             # (where --measure-time is likewise an accepted no-op).
-            print(
-                "[cli] warning: --no-measure-time only applies to the jax "
-                "backend's fused scan; the numpy and cpp backends always "
-                "record measured per-eval timestamps — ignoring",
-                file=sys.stderr,
+            _log.warning(
+                "--no-measure-time only applies to the jax backend's fused "
+                "scan; the numpy and cpp backends always record measured "
+                "per-eval timestamps — ignoring"
             )
 
     if args.preflight:
-        from distributed_optimization_tpu.utils.diagnostics import check_collectives
+        from distributed_optimization_tpu.utils.diagnostics import (
+            PreflightError,
+            run_preflight,
+        )
 
-        check_collectives()
-        if not args.quiet:
-            print("[cli] preflight collective checks passed", file=sys.stderr)
+        try:
+            passed = run_preflight()
+        except PreflightError as e:
+            # Loud, named failure BEFORE any compile/run time is spent:
+            # the broken identity is the diagnosis.
+            raise SystemExit(
+                f"[cli] preflight FAILED at {e.check!r}: {e.cause}"
+            ) from e
+        _log.info("preflight passed: %s", ", ".join(passed))
 
     from distributed_optimization_tpu.utils.diagnostics import nan_debugging
     from distributed_optimization_tpu.utils.profiling import trace
@@ -543,7 +573,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # utils.py:43-48) — makes the sorted-partition non-IID skew visible.
         from distributed_optimization_tpu.utils.data import partition_summary
 
-        print(partition_summary(sim.dataset), file=sys.stderr)
+        _log.info("%s", partition_summary(sim.dataset))
     with trace(args.profile_dir), nan_debugging(args.check_nans):
         if args.suite:
             if "checkpoint" in run_kwargs:
@@ -557,13 +587,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     sim.report_numerical_results()
     if args.plot:
         sim.plot_results(path=args.plot)
-        if not args.quiet:
-            print(f"[cli] figure saved to {args.plot}", file=sys.stderr)
+        _log.info("figure saved to %s", args.plot)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(sim.results_dict(), f, indent=1)
-        if not args.quiet:
-            print(f"[cli] results saved to {args.json}", file=sys.stderr)
+        _log.info("results saved to %s", args.json)
+    if args.telemetry:
+        sim.write_telemetry(args.telemetry)
     return 0
 
 

@@ -245,6 +245,15 @@ class ExperimentConfig:
     dtype: str = "float32"
     matmul_precision: str = "highest"  # jax.lax Precision for parity-sensitive math
     record_consensus: bool = True
+    # Flight-recorder trace buffers (telemetry.py, docs/OBSERVABILITY.md):
+    # record per-eval-row run-health series — per-worker grad/param norms,
+    # non-finite sentinel counts, fault-layer liveness, robust-aggregation
+    # activity — inside the compiled scan (stacked outputs only; the scan
+    # carry and the optimization dataflow are untouched, so trajectories
+    # are bitwise-identical with telemetry on or off). Off by default: the
+    # recording costs one extra gradient per eval point (measured overhead
+    # bound in docs/perf/telemetry.json).
+    telemetry: bool = False
     # Replica-batched execution (jax backend): run this many independent
     # seed replicates — seeds seed, seed+1, ..., seed+replicas−1 — through
     # ONE vmapped compiled program ([R, N, d] state, [R, n_evals] metrics)

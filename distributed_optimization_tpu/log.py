@@ -1,0 +1,71 @@
+"""Package logging (ISSUE-5 satellite).
+
+Library code must not ``print``: diagnostics go through the package logger
+hierarchy ``distributed_optimization_tpu.*`` so applications can route or
+silence them. The CLI maps ``--verbose``/``--quiet`` onto log levels via
+``configure``; direct library users (tests, notebooks) get a stderr handler
+at INFO on first use — the same visible behaviour the old ``print(...,
+file=sys.stderr)`` calls had, now overridable.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+PACKAGE = "distributed_optimization_tpu"
+
+
+class _TagFormatter(logging.Formatter):
+    """``[simulator] message`` — the short tag the old prints used (the last
+    dotted component of the logger name)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        tag = record.name.rsplit(".", 1)[-1]
+        return f"[{tag}] {record.getMessage()}"
+
+
+class _StderrHandler(logging.StreamHandler):
+    """StreamHandler resolving ``sys.stderr`` at EMIT time, not creation —
+    so stream redirection (pytest capsys, contextlib.redirect_stderr) sees
+    the records, exactly as the old ``print(..., file=sys.stderr)`` did."""
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+
+def _ensure_handler() -> logging.Logger:
+    root = logging.getLogger(PACKAGE)
+    if not root.handlers:
+        handler = _StderrHandler()
+        handler.setFormatter(_TagFormatter())
+        root.addHandler(handler)
+        root.propagate = False
+        if root.level == logging.NOTSET:
+            root.setLevel(logging.INFO)
+    return root
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    """Logger under the package hierarchy: ``get_logger('simulator')`` →
+    ``distributed_optimization_tpu.simulator`` (tagged ``[simulator]``)."""
+    _ensure_handler()
+    return logging.getLogger(PACKAGE if not name else f"{PACKAGE}.{name}")
+
+
+def configure(verbosity: int = 0) -> None:
+    """Map a CLI verbosity to the package log level.
+
+    ``verbosity`` < 0 (``--quiet``) → WARNING, 0 → INFO,
+    > 0 (``--verbose``) → DEBUG.
+    """
+    level = (
+        logging.WARNING if verbosity < 0
+        else logging.DEBUG if verbosity > 0
+        else logging.INFO
+    )
+    _ensure_handler().setLevel(level)

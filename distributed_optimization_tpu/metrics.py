@@ -46,6 +46,15 @@ class RunHistory:
     # interpolation of the total run wall-clock (fully fused scan) — the
     # report marks derived sec→ε values accordingly.
     time_measured: bool = False
+    # Flight-recorder buffers (config.telemetry; telemetry.TRACE_FIELDS):
+    # dict of per-eval-row health series — [n_evals] scalars and
+    # [n_evals, N] per-worker rows, float32 — or None when telemetry is off
+    # or the backend records none (cpp).
+    trace: Optional[dict] = None
+    # XLA cost analysis of the compiled program (telemetry.cost_from_lowered:
+    # flops, bytes_accessed, ...); None off the jax path or when telemetry
+    # is off.
+    cost: Optional[dict] = None
 
     def as_dict(self) -> dict:
         out = {

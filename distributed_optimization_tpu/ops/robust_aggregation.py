@@ -97,6 +97,24 @@ def validate_budget(min_degree: int, budget: int, aggregation: str) -> None:
         )
 
 
+def _adaptive_clip_tau(mask, norms, budget: int, k_cap: int):
+    """Adaptive ClippedGossip radius over masked neighbor distances: the
+    (deg−b)-th smallest realized neighbor-difference norm, so exactly the
+    ``b`` most-distant messages get clipped into the honest envelope;
+    deg ≤ b ⇒ τ = 0 (identity row). ``mask``: realized adjacency/liveness
+    weights (> 0 = live slot); ``k_cap``: the sortable axis length (N for
+    the dense form, k_max for gather). ONE definition shared by both
+    aggregator forms and their telemetry activity twins — the probe must
+    see exactly the radius the rule uses.
+    """
+    deg = jnp.sum(mask, axis=1).astype(jnp.int32)
+    masked = jnp.where(mask > 0, norms, jnp.inf)
+    ranked = jnp.sort(masked, axis=1)
+    k = jnp.clip(deg - budget - 1, 0, k_cap - 1)
+    kth = jnp.take_along_axis(ranked, k[:, None], axis=1)[:, 0]
+    return jnp.where(deg - budget >= 1, kth, 0.0)
+
+
 def make_robust_aggregator(
     name: str, budget: int, clip_tau: float = 0.0
 ) -> RobustAggregator:
@@ -186,15 +204,7 @@ def make_robust_aggregator(
             if not adaptive_tau:
                 tau = jnp.full(A.shape[0], clip_tau, dtype=acc)
             else:
-                # Adaptive radius: the (deg−b)-th smallest neighbor
-                # distance — the b most-distant messages get clipped into
-                # the envelope of the rest. deg ≤ b ⇒ τ = 0 (identity row).
-                deg = jnp.sum(Aa, axis=1).astype(jnp.int32)
-                masked = jnp.where(Aa > 0, norms, jnp.inf)
-                ranked = jnp.sort(masked, axis=1)
-                k = jnp.clip(deg - budget - 1, 0, A.shape[0] - 1)
-                kth = jnp.take_along_axis(ranked, k[:, None], axis=1)[:, 0]
-                tau = jnp.where(deg - budget >= 1, kth, 0.0)
+                tau = _adaptive_clip_tau(Aa, norms, budget, A.shape[0])
             factor = jnp.minimum(
                 1.0, tau[:, None] / jnp.maximum(norms, jnp.finfo(acc).tiny)
             )
@@ -296,14 +306,7 @@ def make_gather_robust_aggregator(
             if not adaptive_tau:
                 tau = jnp.full(nbr.shape[0], clip_tau, dtype=acc)
             else:
-                # Adaptive radius: the (deg−b)-th smallest realized
-                # neighbor distance; deg ≤ b ⇒ τ = 0 (identity row).
-                degi = deg.astype(jnp.int32)
-                masked = jnp.where(lv > 0, norms, jnp.inf)
-                ranked = jnp.sort(masked, axis=1)
-                k = jnp.clip(degi - budget - 1, 0, k_max - 1)
-                kth = jnp.take_along_axis(ranked, k[:, None], axis=1)[:, 0]
-                tau = jnp.where(degi - budget >= 1, kth, 0.0)
+                tau = _adaptive_clip_tau(lv, norms, budget, k_max)
             # MH weights on realized degrees, gather form: the liveness is
             # symmetric, so a neighbor's realized degree is its row sum
             # gathered through the slot table; dead slots carry lv = 0.
@@ -315,6 +318,149 @@ def make_gather_robust_aggregator(
             return (xa + moved).astype(x.dtype)
 
     return aggregate
+
+
+def _screening_fraction(name: str, budget: int, counts):
+    """Fraction of received (open-neighborhood) messages a count-only rule
+    screens out, given realized CLOSED-neighborhood counts ``counts``.
+
+    trimmed_mean keeps max(c−2b, 1) values (1 = the identity-row
+    degradation), the median keeps the middle one (two for even counts);
+    everything else of the c−1 received messages is screened. Shared by the
+    jax activity twins below; float32 like all fault-layer accounting.
+    """
+    c = counts.astype(jnp.float32)
+    if name == "trimmed_mean":
+        kept = jnp.maximum(c - 2.0 * budget, 1.0)
+    else:  # median
+        kept = 2.0 - jnp.mod(c, 2.0)
+    return (c - kept) / jnp.maximum(c - 1.0, 1.0)
+
+
+def make_robust_activity(
+    name: str, budget: int, clip_tau: float = 0.0
+) -> RobustAggregator:
+    """Telemetry twin of ``make_robust_aggregator``: ``activity(A_t, x) ->
+    scalar`` — the network-mean fraction of received neighbor messages the
+    rule screened out this round (trimmed values for trimmed_mean/median;
+    messages actually clipped — ‖diff‖ > τᵢ — for clipped_gossip, with τᵢ
+    recomputed exactly as the aggregator computes it). Pure observability:
+    nothing here feeds back into the step. float32 output.
+    """
+    if name not in AGGREGATIONS or name == "gossip":
+        raise ValueError(
+            f"no robust aggregator named {name!r}; plain gossip screens "
+            "nothing (activity is identically 0)"
+        )
+    if budget < 1:
+        raise ValueError(f"{name} needs a positive attack budget, got {budget}")
+
+    if name in ("trimmed_mean", "median"):
+
+        def activity(A, x):
+            counts = jnp.sum(A.astype(jnp.float32), axis=1) + 1.0
+            return jnp.mean(_screening_fraction(name, budget, counts))
+
+    else:  # clipped_gossip — same adaptive/fixed τ decision as the rule
+        adaptive_tau = isinstance(clip_tau, (int, float)) and clip_tau <= 0.0
+
+        def activity(A, x):
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            Aa = A.astype(acc)
+            xa = x.astype(acc)
+            diffs = xa[None, :, :] - xa[:, None, :]
+            norms = jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
+            if not adaptive_tau:
+                tau = jnp.full(A.shape[0], clip_tau, dtype=acc)
+            else:
+                tau = _adaptive_clip_tau(Aa, norms, budget, A.shape[0])
+            clipped = jnp.sum(Aa * (norms > tau[:, None]))
+            return (clipped / jnp.maximum(jnp.sum(Aa), 1.0)).astype(
+                jnp.float32
+            )
+
+    return activity
+
+
+def make_gather_robust_activity(
+    name: str, budget: int, nbr_idx: np.ndarray, clip_tau: float = 0.0
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Degree-bounded twin of ``make_robust_activity``: ``activity(live, x)``
+    over the static [N, k_max] neighbor table + per-slot liveness bits —
+    the same realization the gather aggregator screens. float32 output.
+    """
+    if name not in AGGREGATIONS or name == "gossip":
+        raise ValueError(
+            f"no robust aggregator named {name!r}; plain gossip screens "
+            "nothing (activity is identically 0)"
+        )
+    if budget < 1:
+        raise ValueError(f"{name} needs a positive attack budget, got {budget}")
+    nbr = jnp.asarray(nbr_idx, dtype=jnp.int32)
+    k_max = nbr.shape[1]
+
+    if name in ("trimmed_mean", "median"):
+
+        def activity(live, x):
+            counts = jnp.sum(live.astype(jnp.float32), axis=1) + 1.0
+            return jnp.mean(_screening_fraction(name, budget, counts))
+
+    else:  # clipped_gossip
+
+        adaptive_tau = isinstance(clip_tau, (int, float)) and clip_tau <= 0.0
+
+        def activity(live, x):
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            lv = live.astype(acc)
+            xa = x.astype(acc)
+            diffs = xa[nbr] - xa[:, None, :]
+            norms = jnp.sqrt(jnp.sum(diffs * diffs, axis=-1))
+            if not adaptive_tau:
+                tau = jnp.full(nbr.shape[0], clip_tau, dtype=acc)
+            else:
+                tau = _adaptive_clip_tau(lv, norms, budget, k_max)
+            clipped = jnp.sum(lv * (norms > tau[:, None]))
+            return (clipped / jnp.maximum(jnp.sum(lv), 1.0)).astype(
+                jnp.float32
+            )
+
+    return activity
+
+
+def robust_activity_np(
+    name: str, A: np.ndarray, x: np.ndarray, budget: int, clip_tau: float = 0.0
+) -> float:
+    """Independent per-node oracle of the activity twins (float64 numpy,
+    numpy-backend convention — written from the definitions, not the jax
+    forms)."""
+    n = x.shape[0]
+    if name in ("trimmed_mean", "median"):
+        fracs = []
+        for i in range(n):
+            c = int(A[i].sum()) + 1
+            if name == "trimmed_mean":
+                kept = max(c - 2 * budget, 1)
+            else:
+                kept = 2 - (c % 2)
+            fracs.append((c - kept) / max(c - 1, 1))
+        return float(np.mean(fracs))
+    if name != "clipped_gossip":
+        raise ValueError(f"no robust aggregator named {name!r}")
+    clipped = 0.0
+    total = 0.0
+    for i in range(n):
+        nbrs = np.nonzero(A[i])[0]
+        if len(nbrs) == 0:
+            continue
+        norms = np.linalg.norm(x[nbrs] - x[i], axis=1)
+        if clip_tau > 0.0:
+            tau = clip_tau
+        else:
+            k = len(nbrs) - budget
+            tau = float(np.sort(norms)[k - 1]) if k >= 1 else 0.0
+        clipped += float(np.sum(norms > tau))
+        total += float(len(nbrs))
+    return clipped / total if total else 0.0
 
 
 def robust_aggregate_np(

@@ -22,8 +22,15 @@ def _fmt_sci(v: float) -> str:
     return f"{v:.3e}"
 
 
-def format_report(records, config, f_opt: float) -> str:
-    """Render the numerical-results table for a list of ExperimentRecords."""
+def format_report(records, config, f_opt: float, phases=None) -> str:
+    """Render the numerical-results table for a list of ExperimentRecords.
+
+    ``phases``: optional {name: seconds} wall-clock phase accounting
+    (Simulator's PhaseTimer) appended as its own section. Records carrying
+    flight-recorder state (``config.telemetry``) additionally get a
+    run-health section: worst-worker gradient norm, non-finite counts, and
+    realized-vs-nominal connectivity (docs/OBSERVABILITY.md).
+    """
     lines = [
         "=" * 78,
         f"Numerical results — problem={config.problem_type}, N={config.n_workers}, "
@@ -115,7 +122,49 @@ def format_report(records, config, f_opt: float) -> str:
             "~ sec→ε interpolated from total run wall-clock "
             "(use --measure-time for per-eval timestamps)"
         )
+    health_lines = _health_section(records)
+    if health_lines:
+        lines.append("run health (telemetry):")
+        lines += health_lines
+    if phases:
+        total = sum(phases.values())
+        lines.append("phases:")
+        for name, secs in sorted(phases.items(), key=lambda kv: -kv[1]):
+            share = secs / total if total > 0 else 0.0
+            lines.append(f"  {name:<12}{secs:>10.3f}s{share:>8.1%}")
     return "\n".join(lines)
+
+
+def _health_section(records) -> list[str]:
+    """Run-health lines for records that recorded trace buffers."""
+    lines: list[str] = []
+    for rec in records:
+        h = getattr(rec, "health", None)
+        if h is None:
+            continue
+        parts = []
+        if "worst_worker_grad_norm" in h:
+            parts.append(
+                f"worst grad-norm {h['worst_worker_grad_norm']:.3e} "
+                f"(worker {h['worst_worker']})"
+            )
+        if "nonfinite_total" in h:
+            parts.append(f"non-finite {int(h['nonfinite_total'])}")
+        if h.get("realized_edge_frac") is not None:
+            parts.append(
+                f"realized edges {h['realized_edge_frac']:.1%} of nominal"
+            )
+        wc = h.get("windowed_connectivity")
+        if wc is not None:
+            bhat = wc.get("bhat")
+            parts.append(
+                f"B̂ {bhat if bhat is not None else '∞ (disconnected union)'}"
+            )
+        if h.get("clip_frac_mean"):
+            parts.append(f"screened msgs {h['clip_frac_mean']:.1%}")
+        if parts:
+            lines.append(f"  {rec.label:<26}" + ", ".join(parts))
+    return lines
 
 
 def _finite_curve(iters: np.ndarray, values: Optional[np.ndarray]):

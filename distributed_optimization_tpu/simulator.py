@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import sys
+import time
 from typing import Optional
 
 import numpy as np
@@ -37,6 +37,7 @@ from distributed_optimization_tpu.backends.base import (
     run_algorithm_batch,
 )
 from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.log import get_logger
 from distributed_optimization_tpu.metrics import (
     NumericalResult,
     ReplicateStats,
@@ -48,6 +49,9 @@ from distributed_optimization_tpu.utils.data import (
     generate_synthetic_dataset,
 )
 from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+from distributed_optimization_tpu.utils.profiling import PhaseTimer
+
+_log = get_logger("simulator")
 
 # The reference's experiment matrix (simulator.py:99-132): algorithm,
 # topology (None = centralized), display label.
@@ -77,6 +81,10 @@ class ExperimentRecord:
     skipped_reason: Optional[str] = None
     batch: Optional[object] = None  # jax_backend.BatchRunResult
     replicate_stats: Optional[ReplicateStats] = None
+    # Derived run-health block (telemetry.health_summary) — populated when
+    # the run recorded flight-recorder trace buffers (config.telemetry);
+    # read by format_report's run-health section and the RunTrace manifest.
+    health: Optional[dict] = None
 
 
 class Simulator:
@@ -92,14 +100,22 @@ class Simulator:
         self, base_config: ExperimentConfig, dataset: Optional[HostDataset] = None
     ):
         self.config = base_config
-        self.dataset = (
-            dataset if dataset is not None else generate_synthetic_dataset(base_config)
-        )
-        self.w_opt, self.f_opt = compute_reference_optimum(
-            self.dataset, base_config.reg_param,
-            huber_delta=base_config.huber_delta,
-            n_classes=base_config.n_classes,
-        )
+        # Phase accounting (utils/profiling.PhaseTimer, ISSUE-5 satellite):
+        # data-gen, oracle, compile, and run wall-clock collected across the
+        # simulator's lifetime — surfaced in the text report, the JSON dump,
+        # and the RunTrace manifests.
+        self.phase_timer = PhaseTimer()
+        with self.phase_timer.phase("data_gen"):
+            self.dataset = (
+                dataset if dataset is not None
+                else generate_synthetic_dataset(base_config)
+            )
+        with self.phase_timer.phase("oracle"):
+            self.w_opt, self.f_opt = compute_reference_optimum(
+                self.dataset, base_config.reg_param,
+                huber_delta=base_config.huber_delta,
+                n_classes=base_config.n_classes,
+            )
         self.records: list[ExperimentRecord] = []
 
     # ------------------------------------------------------------------ runs
@@ -129,12 +145,14 @@ class Simulator:
                 f", replicas={len(kwargs['seeds']) if 'seeds' in kwargs else cfg.replicas}"
                 if replicated else ""
             )
-            print(f"[simulator] running {label!r} "
-                  f"(algorithm={cfg.algorithm}, topology={cfg.topology}, "
-                  f"backend={cfg.backend}, T={cfg.n_iterations}{rep})",
-                  file=sys.stderr)
+            _log.info(
+                "running %r (algorithm=%s, topology=%s, backend=%s, T=%s%s)",
+                label, cfg.algorithm, cfg.topology, cfg.backend,
+                cfg.n_iterations, rep,
+            )
         batch = None
         stats = None
+        t_run = time.perf_counter()
         if replicated:
             # One vmapped program runs every replica (ISSUE-4): the record
             # keeps replica 0 as the representative trajectory and the
@@ -151,6 +169,17 @@ class Simulator:
             )
         else:
             result = run_algorithm(cfg, self.dataset, self.f_opt, **kwargs)
+        total_seconds = time.perf_counter() - t_run
+        # Phase split: compile is measured inside the backend (AOT lowering);
+        # the remainder of the wall-clock around the call is the run phase.
+        compile_seconds = min(result.history.compile_seconds, total_seconds)
+        self.phase_timer.phases["compile"] = (
+            self.phase_timer.phases.get("compile", 0.0) + compile_seconds
+        )
+        self.phase_timer.phases["run"] = (
+            self.phase_timer.phases.get("run", 0.0)
+            + total_seconds - compile_seconds
+        )
         summary = summarize_run(
             label,
             result.history,
@@ -158,27 +187,31 @@ class Simulator:
             cfg.n_workers,
             spectral_gap=result.history.spectral_gap,
         )
+        health = None
+        if cfg.telemetry:
+            from distributed_optimization_tpu.telemetry import health_summary
+
+            health = health_summary(cfg, result.history)
         record = ExperimentRecord(
-            label, cfg, result, summary, batch=batch, replicate_stats=stats
+            label, cfg, result, summary, batch=batch, replicate_stats=stats,
+            health=health,
         )
         self.records.append(record)
         if verbose:
             if stats is not None:
-                print(
-                    f"[simulator] {label!r}: final gap "
-                    f"{stats.final_gap_mean:.5f} ± {stats.final_gap_std:.5f} "
-                    f"over {stats.n_replicas} replicas, "
-                    f"{stats.aggregate_iters_per_second:.1f} aggregate "
-                    "iters/sec",
-                    file=sys.stderr,
+                _log.info(
+                    "%r: final gap %.5f ± %.5f over %d replicas, "
+                    "%.1f aggregate iters/sec",
+                    label, stats.final_gap_mean, stats.final_gap_std,
+                    stats.n_replicas, stats.aggregate_iters_per_second,
                 )
             else:
-                gap = result.history.objective[-1]
-                print(
-                    f"[simulator] {label!r}: final gap {gap:.5f}, "
-                    f"iters-to-threshold {summary.iterations_to_threshold}, "
-                    f"{result.history.iters_per_second:.1f} iters/sec",
-                    file=sys.stderr,
+                _log.info(
+                    "%r: final gap %.5f, iters-to-threshold %s, "
+                    "%.1f iters/sec",
+                    label, result.history.objective[-1],
+                    summary.iterations_to_threshold,
+                    result.history.iters_per_second,
                 )
         return record
 
@@ -226,12 +259,44 @@ class Simulator:
 
     # -------------------------------------------------------------- reporting
     def report_numerical_results(self) -> str:
-        """Text report (reference ``simulator.py:139-159``); also returned."""
+        """Text report (reference ``simulator.py:139-159``); also returned.
+
+        The report itself is the product (stdout), not a diagnostic —
+        it stays a print, unlike the progress logging above.
+        """
         from distributed_optimization_tpu.reporting import format_report
 
-        text = format_report(self.records, self.config, self.f_opt)
+        text = format_report(
+            self.records, self.config, self.f_opt,
+            phases=dict(self.phase_timer.phases),
+        )
         print(text)
         return text
+
+    # ------------------------------------------------------------- telemetry
+    def run_traces(self) -> list:
+        """One ``telemetry.RunTrace`` manifest per completed record —
+        config + hash, phase timings, cost analysis, trace buffers, and the
+        derived health block (skipped rows emit nothing)."""
+        from distributed_optimization_tpu.telemetry import build_run_trace
+
+        traces = []
+        for rec in self.records:
+            if rec.skipped_reason is not None or rec.result is None:
+                continue
+            traces.append(build_run_trace(
+                rec.label, rec.config, rec.result.history,
+                phases=dict(self.phase_timer.phases),
+                health=rec.health,
+            ))
+        return traces
+
+    def write_telemetry(self, path) -> None:
+        """Serialize the run manifests as JSONL (one manifest per line)."""
+        from distributed_optimization_tpu.telemetry import write_jsonl
+
+        write_jsonl(path, self.run_traces())
+        _log.info("telemetry manifests saved to %s", path)
 
     def plot_results(self, path: Optional[str] = None, show: bool = False):
         """Two-panel log-scale figure (reference ``simulator.py:161-201``)."""
@@ -249,6 +314,9 @@ class Simulator:
         out = {
             "config": self.config.to_dict(),
             "f_opt": float(self.f_opt),
+            "phases": {
+                k: float(v) for k, v in self.phase_timer.phases.items()
+            },
             "runs": [],
         }
         for rec in self.records:
@@ -271,6 +339,8 @@ class Simulator:
                     final_objective_gap=float(rec.result.history.objective[-1]),
                     history=rec.result.history.as_dict(),
                 )
+                if rec.health is not None:
+                    row["health"] = rec.health
                 if rec.replicate_stats is not None:
                     s = rec.replicate_stats
                     it_mean = s.iterations_to_threshold_mean

@@ -1,0 +1,418 @@
+"""Flight recorder: trace buffers, cost accounting, versioned run manifests.
+
+The backends compile whole runs into fused scans where everything between
+eval points is invisible; this module is the structured-observability layer
+on top of them (ISSUE-5 tentpole):
+
+- **trace buffers** (``TRACE_FIELDS``): opt-in per-eval-row health series —
+  per-worker gradient/parameter norms, non-finite sentinel counts, realized
+  fault-layer liveness (node-up masks, live-edge counts), and robust-
+  aggregation activity — recorded INSIDE the compiled scan through the
+  scan's stacked outputs (never the carry, so telemetry off or on leaves
+  the optimization dataflow untouched; tests assert bitwise trajectory
+  parity). Both backends emit the same schema: jax fills the rows from the
+  scan ``ys``, the numpy oracle from its per-iteration loop.
+- **cost & phase accounting**: XLA ``Lowered.cost_analysis()`` FLOPs/bytes
+  per compiled program (``cost_from_lowered``) and wall-clock phase timings
+  (``utils.profiling.PhaseTimer``, wired by the Simulator) collected into
+  one structure instead of scattered locals.
+- **versioned run manifests** (``RunTrace``): one schema-versioned artifact
+  per run — config + hash, backend/platform, phase timings, cost analysis,
+  trace buffers, and a derived run-health summary including the realized
+  windowed-connectivity B̂ over the run (the quantity time-varying-gossip
+  convergence actually depends on — Koloskova et al. '20; see
+  ``parallel/faults.py::windowed_connectivity``). Serialized as JSON/JSONL
+  by the Simulator (``write_telemetry``), the CLI (``--telemetry OUT``),
+  and the bench scripts (``write_bench_manifest`` sidecars).
+
+This module is jax-free at import time (like ``config.py``); anything that
+needs the topology/fault machinery imports it lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+# Manifest / trace schema version. Bump when a field is added, removed, or
+# changes meaning; ``RunTrace.from_dict`` rejects versions it does not know.
+SCHEMA_VERSION = 1
+
+# The trace-buffer schema: field name -> row shape kind. 'per_worker'
+# fields are [n_evals, N] float32, 'scalar' fields are [n_evals] float32
+# (one row per eval point; the replica-batched path adds a leading [R]).
+# Both backends emit EXACTLY these keys when telemetry is on — the
+# jax-vs-numpy schema-parity test pins it.
+TRACE_FIELDS: dict[str, str] = {
+    # L2 norm of each worker's minibatch gradient at the eval boundary,
+    # evaluated at the post-step state with the SAME batch realization the
+    # eval iteration's step consumed (counter-based keys on jax; the cached
+    # last-drawn indices on the numpy oracle).
+    "grad_norm": "per_worker",
+    # L2 norm of each worker's model row.
+    "param_norm": "per_worker",
+    # Fault-layer node availability at the eval iteration (1.0 = up);
+    # all-ones when no node-fault process is active.
+    "nodes_up": "per_worker",
+    # Count of non-finite entries across ALL algorithm state leaves — the
+    # NaN/Inf sentinel that otherwise stays invisible until the final fetch.
+    "nonfinite": "scalar",
+    # Realized directed-degree sum Σ_i deg_i(t) at the eval iteration (the
+    # fault layer's live-edge accounting; the static topology's degree sum
+    # when fault-free, 0.0 for centralized runs).
+    "live_edges": "scalar",
+    # Robust-aggregation activity: fraction of received closed-neighborhood
+    # messages screened out (trimmed / clipped) this round; 0.0 when no
+    # robust rule is active. See ops/robust_aggregation.py activity twins.
+    "clip_frac": "scalar",
+}
+
+_RUN_TRACE_KEYS = (
+    "schema_version", "kind", "label", "backend", "platform", "config",
+    "config_hash", "phases", "compile_seconds", "iters_per_second",
+    "eval_iterations", "cost", "trace", "health",
+)
+
+# Top-level keys of a bench manifest sidecar (``write_bench_manifest``);
+# the drift-guard schema test validates committed ``*.manifest.json``
+# artifacts against exactly this set.
+BENCH_MANIFEST_KEYS = (
+    "schema_version", "kind", "artifact", "backend", "platform", "config",
+    "config_hash", "phases",
+)
+
+
+def _encode_nonfinite(obj):
+    """NaN/±Inf → the sentinel strings "NaN"/"Infinity"/"-Infinity".
+
+    A flight recorder exists precisely for divergent runs, whose
+    grad-norm/gap rows ARE non-finite — and bare NaN/Infinity tokens are
+    invalid JSON (jq / JSON.parse reject them). Sentinel strings keep the
+    manifests strict-JSON and round-trip exactly through
+    ``_decode_nonfinite``.
+    """
+    if isinstance(obj, float):
+        if math.isnan(obj):
+            return "NaN"
+        if math.isinf(obj):
+            return "Infinity" if obj > 0 else "-Infinity"
+        return obj
+    if isinstance(obj, dict):
+        return {k: _encode_nonfinite(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_encode_nonfinite(v) for v in obj]
+    return obj
+
+
+_NONFINITE = {"NaN": float("nan"), "Infinity": float("inf"),
+              "-Infinity": float("-inf")}
+
+
+def _decode_nonfinite(obj):
+    if isinstance(obj, str) and obj in _NONFINITE:
+        return _NONFINITE[obj]
+    if isinstance(obj, dict):
+        return {k: _decode_nonfinite(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode_nonfinite(v) for v in obj]
+    return obj
+
+
+def config_hash(config_dict: dict) -> str:
+    """Stable content hash of a config dict (sorted-key JSON, sha256)."""
+    blob = json.dumps(config_dict, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def cost_from_lowered(lowered) -> Optional[dict]:
+    """Extract the XLA cost analysis of a ``jax.stages.Lowered`` program.
+
+    Returns a small float dict (flops, bytes accessed, ...) or None when
+    the platform/version provides no analysis — never raises: cost numbers
+    are telemetry, not control flow.
+    """
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    keep = ("flops", "bytes accessed", "transcendentals", "optimal_seconds")
+    out = {k.replace(" ", "_"): float(ca[k]) for k in keep if k in ca}
+    return out or None
+
+
+def _platform() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
+@dataclasses.dataclass
+class RunTrace:
+    """One run's flight-recorder manifest (see the module docstring).
+
+    ``trace`` holds the per-eval-row buffers as plain lists keyed by
+    ``TRACE_FIELDS`` (None when telemetry was off or the backend emits
+    none); ``health`` the derived summary from ``health_summary``.
+    """
+
+    label: str
+    backend: str
+    platform: str
+    config: dict
+    config_hash: str
+    phases: dict
+    compile_seconds: float
+    iters_per_second: float
+    eval_iterations: list
+    cost: Optional[dict] = None
+    trace: Optional[dict] = None
+    health: Optional[dict] = None
+    schema_version: int = SCHEMA_VERSION
+    kind: str = "run_trace"
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in _RUN_TRACE_KEYS}
+
+    def to_json(self) -> str:
+        # allow_nan=False + sentinel-string encoding: strict JSON even for
+        # the divergent runs whose trace rows are non-finite.
+        return json.dumps(
+            _encode_nonfinite(self.to_dict()), sort_keys=True,
+            allow_nan=False,
+        )
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RunTrace":
+        unknown = set(d) - set(_RUN_TRACE_KEYS)
+        if unknown:
+            raise ValueError(
+                f"RunTrace carries unknown keys {sorted(unknown)}; "
+                f"schema v{SCHEMA_VERSION} defines {_RUN_TRACE_KEYS}"
+            )
+        missing = set(_RUN_TRACE_KEYS) - set(d)
+        if missing:
+            raise ValueError(f"RunTrace is missing keys {sorted(missing)}")
+        if d["schema_version"] != SCHEMA_VERSION:
+            raise ValueError(
+                f"unsupported RunTrace schema_version {d['schema_version']} "
+                f"(this build reads v{SCHEMA_VERSION})"
+            )
+        if d["kind"] != "run_trace":
+            raise ValueError(f"not a run_trace manifest: kind={d['kind']!r}")
+        return cls(**d)
+
+    @classmethod
+    def from_json(cls, blob: str) -> "RunTrace":
+        return cls.from_dict(_decode_nonfinite(json.loads(blob)))
+
+
+def build_run_trace(
+    label: str,
+    config,
+    history,
+    *,
+    phases: Optional[dict] = None,
+    health: Optional[dict] = None,
+    platform: Optional[str] = None,
+) -> RunTrace:
+    """Assemble a ``RunTrace`` from an ``ExperimentConfig`` + ``RunHistory``."""
+    cd = config.to_dict()
+    trace = None
+    if history.trace is not None:
+        trace = {
+            k: np.asarray(v, dtype=np.float64).tolist()
+            for k, v in history.trace.items()
+        }
+    return RunTrace(
+        label=label,
+        backend=config.backend,
+        platform=platform if platform is not None else _platform(),
+        config=cd,
+        config_hash=config_hash(cd),
+        phases=dict(phases or {}),
+        compile_seconds=float(history.compile_seconds),
+        iters_per_second=float(history.iters_per_second),
+        eval_iterations=np.asarray(history.eval_iterations).tolist(),
+        cost=history.cost,
+        trace=trace,
+        health=health,
+    )
+
+
+def write_jsonl(path, traces: list[RunTrace]) -> None:
+    """One manifest per line (JSONL) — the CLI/Simulator emission format."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    with open(p, "w") as f:
+        for tr in traces:
+            f.write(tr.to_json() + "\n")
+
+
+def read_jsonl(path) -> list[RunTrace]:
+    return [
+        RunTrace.from_json(line)
+        for line in Path(path).read_text().splitlines()
+        if line.strip()
+    ]
+
+
+# --------------------------------------------------------------- run health
+
+
+def realized_bhat(config, max_cells: int = 2_000_000) -> Optional[dict]:
+    """Realized windowed-connectivity B̂ of this config's fault process.
+
+    Rebuilds the run's fault timeline host-side — bitwise the realization
+    the backends consume (memoryless modes are the burst_len=1 /
+    iid-equivalent points of the persistent chains, see
+    ``parallel/faults.py``) — and measures the smallest B such that every
+    length-B window's union graph is connected. Returns ``{"bhat",
+    "horizon"}`` (bhat None when even the full-horizon union is
+    disconnected), or None when the notion does not apply (centralized,
+    matching schedules, no peer graph). The horizon is truncated so the
+    [horizon, E] unroll stays under ``max_cells`` — recorded honestly in
+    the result.
+    """
+    from distributed_optimization_tpu.algorithms import get_algorithm
+    from distributed_optimization_tpu.parallel import build_topology
+    from distributed_optimization_tpu.parallel.faults import (
+        _edge_list,
+        _union_connected,
+        build_fault_timeline,
+        windowed_connectivity,
+    )
+
+    if not get_algorithm(config.algorithm).is_decentralized:
+        return None
+    if config.gossip_schedule != "synchronous":
+        # Matching schedules realize per-round matchings, not edge-drop
+        # processes — the timeline rebuild below would not be the realized
+        # graph sequence.
+        return None
+    topo = build_topology(
+        config.topology, config.n_workers,
+        erdos_renyi_p=config.erdos_renyi_p,
+        seed=config.resolved_topology_seed(),
+    )
+    edges = _edge_list(topo)
+    n_edges = max(len(edges), 1)
+    faults_active = (
+        config.edge_drop_prob > 0.0
+        or config.straggler_prob > 0.0
+        or config.mttf > 0.0
+    )
+    if not faults_active:
+        connected = _union_connected(
+            np.ones(len(edges), dtype=bool), edges, config.n_workers
+        )
+        return {"bhat": 1 if connected else None,
+                "horizon": config.n_iterations}
+    horizon = min(config.n_iterations, max(1, max_cells // n_edges))
+    tl = build_fault_timeline(
+        topo, horizon, config.seed,
+        edge_drop_prob=config.edge_drop_prob,
+        burst_len=config.burst_len if config.burst_len >= 1.0 else 1.0,
+        straggler_prob=(
+            0.0 if config.mttf > 0.0 else config.straggler_prob
+        ),
+        mttf=config.mttf, mttr=config.mttr,
+    )
+    return {"bhat": windowed_connectivity(tl, topo),
+            "horizon": horizon}
+
+
+def health_summary(config, history) -> dict:
+    """Derive the run-health block from a finished run's history.
+
+    Always includes the final gap and the realized/nominal connectivity
+    diagnostics; trace-derived statistics (worst-worker grad norm,
+    non-finite totals, liveness) appear when the run recorded trace
+    buffers.
+    """
+    h: dict[str, Any] = {}
+    obj = np.asarray(history.objective, dtype=np.float64)
+    finite = obj[np.isfinite(obj)]
+    h["final_gap"] = float(obj[-1]) if obj.size else None
+    h["n_nonfinite_evals"] = int(obj.size - finite.size)
+    tr = history.trace
+    if tr:
+        gn = np.asarray(tr["grad_norm"], dtype=np.float64)
+        per_worker_peak = gn.max(axis=tuple(range(gn.ndim - 1)))
+        h["worst_worker_grad_norm"] = float(per_worker_peak.max())
+        h["worst_worker"] = int(per_worker_peak.argmax())
+        h["final_max_param_norm"] = float(
+            np.asarray(tr["param_norm"])[..., -1, :].max()
+        )
+        h["nonfinite_total"] = float(np.sum(tr["nonfinite"]))
+        nodes = np.asarray(tr["nodes_up"], dtype=np.float64)
+        h["min_nodes_up_frac"] = float(nodes.mean(axis=-1).min())
+        h["clip_frac_mean"] = float(np.mean(tr["clip_frac"]))
+        live = np.asarray(tr["live_edges"], dtype=np.float64)
+        nominal = _nominal_degree_sum(config)
+        h["realized_edge_frac"] = (
+            float(live.mean() / nominal) if nominal else None
+        )
+    h["windowed_connectivity"] = realized_bhat(config)
+    return h
+
+
+def _nominal_degree_sum(config) -> Optional[float]:
+    from distributed_optimization_tpu.algorithms import get_algorithm
+    from distributed_optimization_tpu.parallel import build_topology
+
+    if not get_algorithm(config.algorithm).is_decentralized:
+        return None
+    topo = build_topology(
+        config.topology, config.n_workers,
+        erdos_renyi_p=config.erdos_renyi_p,
+        seed=config.resolved_topology_seed(),
+    )
+    return float(np.asarray(topo.adjacency).sum())
+
+
+# ----------------------------------------------------------- bench sidecars
+
+
+def write_bench_manifest(
+    artifact_path, *, config=None, phases=None, artifact_name=None,
+) -> Path:
+    """Write the ``<artifact>.manifest.json`` sidecar for a bench artifact.
+
+    Every ``examples/bench_*.py`` calls this after writing its JSON so regen
+    runs leave a schema-versioned provenance record (platform, config hash,
+    phase timings) next to each number. ``config`` is the bench's base
+    ``ExperimentConfig`` (or a plain dict, or None for benches without one
+    canonical config); ``phases`` a ``PhaseTimer`` or plain dict.
+    """
+    p = Path(artifact_path)
+    out = p.with_suffix(".manifest.json")
+    cd = None
+    if config is not None:
+        cd = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    phase_dict = dict(getattr(phases, "phases", phases) or {})
+    payload = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "bench_manifest",
+        "artifact": artifact_name or p.name,
+        "backend": (cd or {}).get("backend"),
+        "platform": _platform(),
+        "config": cd,
+        "config_hash": config_hash(cd) if cd else None,
+        "phases": {k: float(v) for k, v in phase_dict.items()},
+    }
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+    return out

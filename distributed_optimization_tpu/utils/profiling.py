@@ -5,8 +5,11 @@ The reference's only observability is coarse per-iteration wall-clock deltas
 
 - ``PhaseTimer`` — named phase accounting (data gen, oracle, compile,
   steady-state run), so compile time never pollutes the iters/sec headline
-  (the jax backend already separates AOT compile from execution; this makes
-  the same split available to scripts and the CLI);
+  (the jax backend already separates AOT compile from execution). The
+  ``Simulator`` owns one (``phase_timer``): data-gen and oracle are timed
+  at construction, each run splits into compile/run, and the phases land
+  in the text report, ``--json``, and the telemetry manifests
+  (docs/OBSERVABILITY.md);
 - ``trace`` — context manager around ``jax.profiler`` trace collection for
   TensorBoard/XProf on real TPU runs, a no-op when profiling is unavailable.
 """
@@ -17,6 +20,10 @@ import contextlib
 import dataclasses
 import time
 from typing import Iterator, Optional
+
+from distributed_optimization_tpu.log import get_logger
+
+_log = get_logger("profiling")
 
 
 @dataclasses.dataclass
@@ -61,7 +68,7 @@ def trace(log_dir: Optional[str]) -> Iterator[None]:
     try:
         jax.profiler.start_trace(log_dir)
     except Exception as e:  # pragma: no cover - platform dependent
-        print(f"[profiling] trace unavailable: {e}")
+        _log.warning("trace unavailable: %s", e)
         yield
         return
     try:

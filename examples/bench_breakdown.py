@@ -179,6 +179,10 @@ def main() -> None:
 
     path = out_dir / "breakdown.json"
     path.write_text(json.dumps(results, indent=2) + "\n")
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+
+    write_bench_manifest(path)
+
     print(json.dumps({"wrote": str(path.relative_to(root))}))
 
 

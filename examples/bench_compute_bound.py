@@ -249,6 +249,10 @@ def main() -> None:
     path = Path(args.out)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(payload, indent=2) + "\n")
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+
+    write_bench_manifest(path)
+
     print(json.dumps({
         "metric": "compute_bound_median_mfu_best_cell",
         "value": max(r["mfu_vs_bf16_peak"] for r in results.values()),

@@ -157,6 +157,10 @@ def main() -> None:
     path = Path(args.out)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(out, indent=2) + "\n")
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+
+    write_bench_manifest(path)
+
     print(f"[bench_mixing] winner={winner} -> {path}", file=sys.stderr)
     print(json.dumps({"metric": "mixing_bench_winner", "value": winner}))
 

@@ -187,6 +187,10 @@ def main() -> None:
     path = Path(args.out)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(out, indent=2) + "\n")
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+
+    write_bench_manifest(path)
+
     print(json.dumps({"metric": "pallas_regimes",
                       "value": {k: v["pallas_wins_outside_noise"]
                                 for k, v in verdicts.items()}}))

@@ -92,6 +92,10 @@ def main() -> None:
     perf_dir = root / "docs" / "perf"
     perf_dir.mkdir(parents=True, exist_ok=True)
     (perf_dir / "scaling.json").write_text(json.dumps(out, indent=2) + "\n")
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+
+    write_bench_manifest(perf_dir / "scaling.json")
+
 
     # Figure: iters/sec vs N and consensus decay vs N, same visual language
     # as the repo's report figures (log-scale, matplotlib defaults).

@@ -192,6 +192,10 @@ def main() -> None:
     path = Path(args.out)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(payload, indent=2) + "\n")
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+
+    write_bench_manifest(path)
+
     print(json.dumps({"metric": "sparse_mixing_cells", "value": len(results)}))
 
 
