@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test smoke bench-byzantine bench-churn bench-robust-scale \
-	bench-sweep bench-compute bench-telemetry
+	bench-sweep bench-compute bench-telemetry bench-fused
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -17,7 +17,8 @@ test:
 smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest -q -m 'not slow' -x \
 		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py \
-		tests/test_robust_gather.py tests/test_batch.py \
+		tests/test_robust_gather.py tests/test_fused_robust.py \
+		tests/test_compressed_gossip.py tests/test_batch.py \
 		tests/test_telemetry.py
 
 # Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
@@ -52,3 +53,10 @@ bench-compute:
 # steady-state ceiling + bitwise off/on trajectory gate).
 bench-telemetry:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_telemetry.py
+
+# Regenerate the fused-robust-kernel + compressed-gossip evidence
+# (docs/perf/fused_robust.json: fused vs gather per rule with the
+# compiled-path floor gated to accelerators + honest fused_loses flags,
+# and bytes-vs-gap envelopes for {none,top_k,qsgd} x {dsgd,gt}).
+bench-fused:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_fused_robust.py

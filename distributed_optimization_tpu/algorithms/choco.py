@@ -30,36 +30,45 @@ accounting) — top-k/random-k count k values + k indices.
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-
 from distributed_optimization_tpu.algorithms.base import (
     Algorithm,
     State,
     StepContext,
     register_algorithm,
 )
-from distributed_optimization_tpu.ops.compression import make_compressor
+from distributed_optimization_tpu.ops.compression import (
+    compression_key,
+    make_compressor,
+    make_error_feedback,
+)
 
 
 def _init(x0, config, *, neighbor_sum=None) -> State:
-    return {"x": x0, "xhat": jnp.zeros_like(x0)}
+    ef = make_error_feedback(
+        config.compression, x0.shape[-1], config.compression_k,
+        config.choco_gamma,
+    )
+    return {"x": x0, "xhat": ef.init(x0)}
 
 
 def _step(state: State, ctx: StepContext) -> State:
+    # The original CHOCO recursion, now phrased through the SHARED
+    # error-feedback exchange (ops/compression.py::ErrorFeedbackGossip —
+    # the same machinery compressed dsgd/gradient_tracking run): ops and
+    # the counter-based compressor stream are term-for-term the
+    # pre-refactor step, so trajectories are bitwise-unchanged
+    # (tests/test_choco.py pins the identity-compression == D-SGD
+    # equivalence and the refactor parity).
     cfg = ctx.config
     x, xhat = state["x"], state["xhat"]
-    comp = make_compressor(cfg.compression, x.shape[-1], cfg.compression_k)
-
+    ef = make_error_feedback(
+        cfg.compression, x.shape[-1], cfg.compression_k, cfg.choco_gamma
+    )
     g = ctx.grad(x, 0)
     x_half = x - ctx.eta * g
-    # Distinct counter-based stream for the (possibly randomized) compressor.
-    key = jax.random.fold_in(
-        jax.random.fold_in(jax.random.key(cfg.seed), 0xC0C0), ctx.t
+    x_new, xhat_new = ef.exchange(
+        compression_key(cfg.seed, ctx.t), x_half, xhat, ctx.mix
     )
-    q = comp.apply(key, x_half - xhat)
-    xhat_new = xhat + q
-    x_new = x_half + cfg.choco_gamma * (ctx.mix(xhat_new) - xhat_new)
     return {"x": x_new, "xhat": xhat_new}
 
 

@@ -12,6 +12,21 @@ Communication cost is Σ_i deg_i · d floats per iteration (trainer.py:169-170).
 TPU-native form: the gossip Σ_j W_ij x_j is ``ctx.mix`` — a ppermute stencil
 (ring/torus), an all-reduce mean (fully connected), or a dense contraction
 (irregular graphs) — instead of the reference's simulated ``W @ models``.
+
+Compressed gossip (``config.compression != 'none'``, ISSUE-6 tentpole): the
+exchange routes through the shared error-feedback machinery
+(``ops/compression.py::ErrorFeedbackGossip`` — generalized out of CHOCO):
+the state carries a per-worker estimate x̂ and each round transmits only
+Q(x_half − x̂), the adapt-then-combine recursion
+
+    x_{t+1/2} = x_t − η g(x_t);   x̂⁺ = x̂ + Q(x_{t+1/2} − x̂)
+    x_{t+1}   = x_{t+1/2} + γ (W − I) X̂⁺
+
+— i.e. compressed D-SGD IS CHOCO-SGD run under the D-SGD registration,
+which is exactly the point: the algorithm the production gather path runs
+gains the bytes-per-round knob without changing rule. ``comm_payload``
+feeds the compressor's per-edge float cost into the analytic and realized
+comms accounting (what the bytes-vs-gap benches measure).
 """
 
 from __future__ import annotations
@@ -25,11 +40,40 @@ from distributed_optimization_tpu.algorithms.base import (
 
 
 def _init(x0, config, *, neighbor_sum=None) -> State:
+    if config.compression != "none":
+        from distributed_optimization_tpu.ops.compression import (
+            make_error_feedback,
+        )
+
+        ef = make_error_feedback(
+            config.compression, x0.shape[-1], config.compression_k,
+            config.choco_gamma,
+        )
+        return {"x": x0, "xhat": ef.init(x0)}
     return {"x": x0}
 
 
 def _step(state: State, ctx: StepContext) -> State:
     x = state["x"]
+    if "xhat" in state:
+        # Error-feedback compressed gossip (see the module docstring).
+        from distributed_optimization_tpu.ops.compression import (
+            compression_key,
+            make_error_feedback,
+        )
+
+        cfg = ctx.config
+        ef = make_error_feedback(
+            cfg.compression, x.shape[-1], cfg.compression_k,
+            cfg.choco_gamma,
+        )
+        g = ctx.grad(x, 0)
+        x_half = x - ctx.eta * g
+        x_new, xhat_new = ef.exchange(
+            compression_key(cfg.seed, ctx.t), x_half, state["xhat"],
+            ctx.mix,
+        )
+        return {"x": x_new, "xhat": xhat_new}
     grads = ctx.grad(x, 0)  # at the local pre-mix models (D-PSGD ordering)
     if ctx.fused_mix_step is not None:
         # Backend-fused W x − eta g (single pallas kernel, one HBM pass).
@@ -38,7 +82,18 @@ def _step(state: State, ctx: StepContext) -> State:
     return {"x": x_new}
 
 
+def _comm_payload(config, d: int) -> float:
+    # Per-edge floats per iteration: the compressor's payload (== d for
+    # compression='none', so uncompressed accounting is unchanged).
+    from distributed_optimization_tpu.ops.compression import make_compressor
+
+    return make_compressor(
+        config.compression, d, config.compression_k
+    ).floats_per_edge
+
+
 DSGD = register_algorithm(
     Algorithm(name="dsgd", init=_init, step=_step, gossip_rounds=1,
-              supports_byzantine=True, supports_churn=True)
+              supports_byzantine=True, supports_churn=True,
+              comm_payload=_comm_payload)
 )

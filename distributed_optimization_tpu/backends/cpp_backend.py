@@ -150,6 +150,13 @@ def run(
             "implementation cannot reproduce (same stance as the numpy "
             "oracle)"
         )
+    if config.compression != "none" and config.algorithm != "choco":
+        raise ValueError(
+            "error-feedback compressed dsgd/gradient_tracking is "
+            "implemented on the jax backend and the numpy oracle; the "
+            "native core's compression path covers CHOCO only — running "
+            "it here would silently exchange full vectors"
+        )
     lib = load_library()
 
     n = config.n_workers

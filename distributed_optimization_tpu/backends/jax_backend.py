@@ -157,6 +157,11 @@ class _StepPieces:
     collect_metrics: bool
     track_consensus: bool
     edge_payload: object
+    # Single-kernel robust D-SGD update ``(t, x, g, eta) -> x_new``
+    # (robust_impl='fused' + dsgd; _bind_byzantine). Bound per-iteration
+    # into ``ctx.fused_mix_step`` so the algorithm's canonical
+    # mix-then-step collapses into one pallas pass.
+    fused_robust_step: object = None
     # --- flight recorder (config.telemetry; telemetry.TRACE_FIELDS) ---
     telemetry: bool = False
     # ``activity(t, x) -> scalar``: robust-aggregation screening fraction
@@ -255,6 +260,15 @@ def _make_step_eval(p: _StepPieces, data):
                 nbr_fn = lambda v: base_nbr(  # noqa: E731
                     adversary.corrupt(t, v)
                 )
+        fused_mix_step = p.fused_mix_step
+        if p.fused_robust_step is not None:
+            # robust_impl='fused' + dsgd: the whole corrupt → screen →
+            # mix → SGD update runs as one pallas kernel for iteration t.
+            fused_mix_step = (
+                lambda xx, gg, ee, _t=t: p.fused_robust_step(  # noqa: E731
+                    _t, xx, gg, ee
+                )
+            )
         ctx = StepContext(
             grad=grad_fn_factory(t),
             mix=mix_fn,
@@ -265,7 +279,7 @@ def _make_step_eval(p: _StepPieces, data):
             t=t,
             degrees=p.degrees,
             config=p.config,
-            fused_mix_step=p.fused_mix_step,
+            fused_mix_step=fused_mix_step,
         )
         new_state = p.algo.step(state, ctx)
         if faulty is not None and (
@@ -470,22 +484,32 @@ def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
 
 
 def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
-                    byz=None, noise_key=None):
+                    byz=None, noise_key=None, allow_fused=True,
+                    fused_auto_ok=True):
     """Byzantine adversary + robust-aggregation wiring shared by ``_run``
     and ``run_batch`` (docs/BYZANTINE.md). Returns ``(adversary, byz_mix,
-    activity_t)`` — all None when the config is benign. ``activity_t(t, x)``
-    is the flight recorder's screening-fraction probe (the telemetry twin
-    of the robust rule, over the same realized graph and the same
-    corrupted stack; None without a robust rule). The keyword overrides
-    are the replica-batched hooks: ``clip_tau`` a per-replica (possibly
-    traced) radius, ``byz``/``noise_key`` the per-replica Byzantine set
-    and large-noise stream.
+    activity_t, fused_step_t)`` — all None when the config is benign.
+    ``activity_t(t, x)`` is the flight recorder's screening-fraction probe
+    (the telemetry twin of the robust rule, over the same realized graph
+    and the same corrupted stack; None without a robust rule).
+    ``fused_step_t(t, x, g, eta)`` is the single-kernel robust D-SGD
+    update (gather + screen + mix + SGD in one pallas pass,
+    ``robust_impl='fused'`` + dsgd only) — when set, the step binds it as
+    ``ctx.fused_mix_step`` and the whole per-iteration update runs
+    VMEM-resident. The keyword overrides are the replica-batched hooks:
+    ``clip_tau`` a per-replica (possibly traced) radius,
+    ``byz``/``noise_key`` the per-replica Byzantine set and large-noise
+    stream; ``allow_fused=False`` keeps the vmapped path off the pallas
+    kernel entirely (it addresses unbatched VMEM blocks);
+    ``fused_auto_ok=False`` only stops AUTO from promoting to it (the
+    sharded-mesh case: the kernel would be GSPMD-replicated instead of
+    partitioned — an explicit robust_impl='fused' is still honored).
     """
     byzantine_active = config.attack != "none" or (
         config.aggregation != "gossip" and config.robust_b > 0
     )
     if not byzantine_active:
-        return None, None, None
+        return None, None, None, None
     if not algo.supports_byzantine:
         raise ValueError(
             f"Byzantine injection / robust aggregation is "
@@ -504,31 +528,65 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
     )
     robust_aggregate_t = None
     activity_src = None
+    fused_update = None
     if config.aggregation != "gossip" and config.robust_b > 0:
+        from distributed_optimization_tpu.ops.pallas_kernels import (
+            fused_robust_supported,
+            make_fused_robust_aggregator,
+            make_fused_robust_dsgd_step,
+        )
+
         validate_budget(
             int(topo.degrees.min()), config.robust_b,
             config.aggregation,
         )
         ct = config.clip_tau if clip_tau is None else clip_tau
+        k_max_topo = int(topo.degrees.max())
         # The screened-rule execution form (docs/BYZANTINE.md
         # "Degree-bounded gather path"): 'gather' screens over the
         # static [N, k_max] neighbor table — O(N·k_max·d·log k_max)
-        # — instead of the dense [N, N, d] node-axis sort; 'auto'
-        # routes by the measured crossover (resolved_robust_impl).
-        # Both forms bind the rule to the SAME per-iteration fault
-        # realization, in dense-adjacency or gathered-slot form.
-        robust_impl = config.resolved_robust_impl(
-            int(topo.degrees.max())
+        # — instead of the dense [N, N, d] node-axis sort; 'fused'
+        # runs the gather math as ONE pallas kernel so the
+        # [N, k_max, d] neighbor stack never materializes in HBM;
+        # 'auto' routes by the measured crossover and promotes to
+        # fused only when the production shape is eligible: static
+        # topology (no per-round liveness recompute to overlap),
+        # fused-supported rule at this k_max, and no telemetry
+        # activity probe (the probe would re-run the un-fused
+        # screening maths alongside). An EXPLICIT 'fused' is honored
+        # beyond the auto gate (time-varying liveness feeds the
+        # kernel per step — the parity tests force exactly that),
+        # but never inside the vmapped replica batch.
+        fused_eligible = (
+            allow_fused
+            and fused_auto_ok
+            and faulty is None
+            and not config.telemetry
+            and fused_robust_supported(config.aggregation, k_max_topo, ct)
         )
-        if robust_impl == "gather":
+        robust_impl = config.resolved_robust_impl(
+            k_max_topo, fused_eligible=fused_eligible
+        )
+        if robust_impl == "fused" and not allow_fused:
+            raise ValueError(
+                "robust_impl='fused' cannot run inside the replica-"
+                "batched program: the pallas kernel addresses unbatched "
+                "VMEM blocks — use 'auto', 'gather', or 'dense'"
+            )
+        if robust_impl in ("gather", "fused"):
             from distributed_optimization_tpu.parallel.topology import (
                 neighbor_table,
             )
 
             nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
-            gather_agg = make_gather_robust_aggregator(
-                config.aggregation, config.robust_b, nbr_idx, ct,
-            )
+            if robust_impl == "fused":
+                gather_agg = make_fused_robust_aggregator(
+                    config.aggregation, config.robust_b, nbr_idx, ct,
+                )
+            else:
+                gather_agg = make_gather_robust_aggregator(
+                    config.aggregation, config.robust_b, nbr_idx, ct,
+                )
             if faulty is not None:
                 live_fn = faulty.make_neighbor_liveness(
                     nbr_idx, nbr_mask
@@ -541,6 +599,19 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
             robust_aggregate_t = (
                 lambda t, v: gather_agg(live_fn(t), v)  # noqa: E731
             )
+            if robust_impl == "fused" and algo.name == "dsgd":
+                # D-SGD's whole update fuses: the −η·g lands inside
+                # the same kernel (make_fused_robust_dsgd_step);
+                # composed with the adversary below.
+                fused_update = (
+                    make_fused_robust_dsgd_step(
+                        config.aggregation, config.robust_b, nbr_idx,
+                        ct,
+                    ),
+                    live_fn,
+                )
+            # The activity probe stays the (un-fused) gather twin for
+            # both forms — observability only, off the auto-fused path.
             gather_act = make_gather_robust_activity(
                 config.aggregation, config.robust_b, nbr_idx, ct,
             )
@@ -574,6 +645,26 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
     byz_mix = make_byzantine_mixing(
         adversary, base_mix_t, aggregate_t=robust_aggregate_t,
     )
+    fused_step_t = None
+    if fused_update is not None:
+        fused_kernel, fused_live = fused_update
+
+        def fused_step_t(t, x, g, eta):
+            # The single-kernel twin of ``byz_mix(t, x) − η·g`` for D-SGD
+            # (make_byzantine_mixing composition, SGD folded in): honest
+            # rows screen the corrupted stack in-kernel; Byzantine rows
+            # keep the benign mix of the TRUE stack (the attacker-runs-
+            # honest-dynamics threat model) — elementwise the same values
+            # as select-then-subtract, so the fused path stays bitwise.
+            xc = adversary.corrupt(t, x) if adversary is not None else x
+            out = fused_kernel(fused_live(t), xc, g, eta)
+            if adversary is not None:
+                m = jnp.asarray(
+                    adversary.byzantine, dtype=jnp.float32
+                ).reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+                out = jnp.where(m > 0, base_mix_t(t, x) - eta * g, out)
+            return out
+
     activity_t = None
     if activity_src is not None:
         # The probe sees exactly what the screening rule sees: the stack
@@ -584,7 +675,7 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
             )
         else:
             activity_t = activity_src
-    return adversary, byz_mix, activity_t
+    return adversary, byz_mix, activity_t, fused_step_t
 
 
 def _run_chunked(
@@ -1067,8 +1158,15 @@ def _run(
         # keeps the plain gossip path bitwise (a robust rule degrades to
         # MH gossip at zero budget by definition).
         faulty = _build_faulty(config, algo, topo, T)
-        adversary, byz_mix, robust_activity = _bind_byzantine(
-            config, algo, topo, faulty, mix_op
+        adversary, byz_mix, robust_activity, fused_robust_step = (
+            _bind_byzantine(
+                config, algo, topo, faulty, mix_op,
+                # Auto only promotes to the fused kernel on unsharded
+                # runs: under a worker mesh GSPMD would replicate the
+                # pallas call (no partitioning rule) where the gather
+                # ops shard — explicit robust_impl='fused' still runs.
+                fused_auto_ok=mesh is None,
+            )
         )
         static_degree_sum = float(np.asarray(topo.adjacency).sum())
     else:
@@ -1090,6 +1188,7 @@ def _run(
         adversary = None
         byz_mix = None
         robust_activity = None
+        fused_robust_step = None
         static_degree_sum = 0.0
         topo = None
         mix_op = None
@@ -1191,6 +1290,7 @@ def _run(
         fused_mix_step=fused_mix_step, full_objective=full_objective,
         f_opt=f_opt, collect_metrics=collect_metrics,
         track_consensus=track_consensus, edge_payload=edge_payload,
+        fused_robust_step=fused_robust_step,
         telemetry=config.telemetry, robust_activity=robust_activity,
         static_degree_sum=static_degree_sum,
     )
@@ -1668,6 +1768,21 @@ def _run_batch(
             "mesh and the pallas kernels address unbatched VMEM blocks — "
             "use 'auto', 'dense', 'stencil', or 'sparse'"
         )
+    if config.robust_impl == "fused":
+        raise ValueError(
+            "run_batch is incompatible with robust_impl='fused': the "
+            "fused pallas kernel addresses unbatched VMEM blocks — use "
+            "'auto', 'gather', or 'dense' (auto never promotes to fused "
+            "inside the replica batch)"
+        )
+    if config.compression != "none":
+        raise ValueError(
+            "run_batch does not support compressed gossip: the "
+            "error-feedback step derives its compressor stream from "
+            "config.seed internally, which the batched per-replica seed "
+            "axis cannot reach — replicas would silently share "
+            "compression draws"
+        )
     if config.tp_degree > 1:
         raise ValueError(
             "run_batch and tp_degree > 1 are mutually exclusive: the TP "
@@ -1926,11 +2041,12 @@ def _run_batch(
                     ),
                     timeline=tl, horizon=horizon,
                 )
-            adversary, byz_mix, robust_activity = _bind_byzantine(
+            adversary, byz_mix, robust_activity, _ = _bind_byzantine(
                 config, algo, topo, faulty, mix_op,
                 clip_tau=rp_r.get("clip_tau"),
                 byz=rp_r.get("byz"),
                 noise_key=rp_r.get("noise_key"),
+                allow_fused=False,
             )
             if adversary is not None:
                 honest_w = jnp.asarray(

@@ -168,13 +168,20 @@ def run(
     shards = [dataset.shard(i) for i in range(n)]
     shard_sizes = [Xi.shape[0] for Xi, _ in shards]
 
-    if config.algorithm == "choco" and config.compression in ("random_k", "qsgd"):
+    if config.compression in ("random_k", "qsgd"):
         raise ValueError(
-            "the numpy CHOCO oracle supports the deterministic compressors "
-            "(none, top_k); random_k/qsgd draw from the jax counter-based "
-            "PRNG inside the step, which an independent host implementation "
-            "cannot reproduce without importing the code under test"
+            "the numpy error-feedback oracle supports the deterministic "
+            "compressors (none, top_k); random_k/qsgd draw from the jax "
+            "counter-based PRNG inside the step, which an independent host "
+            "implementation cannot reproduce without importing the code "
+            "under test"
         )
+    # Compressed dsgd shares CHOCO's matrix recursion (it IS the CHOCO
+    # update registered under dsgd — see algorithms/dsgd.py); compressed
+    # gradient tracking extends the GT matrix form with per-leaf
+    # error-feedback estimates. Both therefore take the matrix-form
+    # branch below instead of the shared Algorithm.step rules.
+    compressed = config.compression != "none"
     if algo.is_decentralized:
         topo = build_topology(
             config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
@@ -338,11 +345,49 @@ def run(
 
         return grad
 
-    if config.algorithm in _MATRIX_FORM:
+    if config.algorithm in _MATRIX_FORM or (
+        config.algorithm == "dsgd" and compressed
+    ):
         # Independent matrix recursions (NOT algo.init/algo.step): state
         # leaves written out explicitly from the published update equations.
         zeros = np.zeros((n, d))
-        if config.algorithm == "gradient_tracking":
+        if config.algorithm == "gradient_tracking" and compressed:
+            # Compressed DIGing (the jax rule's independent float64 twin,
+            # algorithms/gradient_tracking.py): BOTH gossip rounds replace
+            # W v with the error-feedback exchange v + γ(W X̂⁺ − X̂⁺) over
+            # per-leaf estimate memories; Q = identity or per-row top-k
+            # (randomized compressors rejected above). Compression
+            # excludes faults/Byzantine by config, so W is static here.
+            gamma = config.choco_gamma
+            k_comp = config.compression_k
+            compress = (
+                (lambda v: v) if config.compression == "none"
+                else (lambda v: _topk_rows(v, k_comp))
+            )
+            state = {"x": zeros.copy(), "y": zeros.copy(),
+                     "g": zeros.copy(), "xhat": zeros.copy(),
+                     "yhat": zeros.copy()}
+
+            def matrix_step(state, t, eta, grad_at):
+                xhat_new = state["xhat"] + compress(
+                    state["x"] - state["xhat"]
+                )
+                x_new = (
+                    state["x"] + gamma * (W @ xhat_new - xhat_new)
+                    - eta * state["y"]
+                )
+                g_new = grad_at(x_new)
+                yhat_new = state["yhat"] + compress(
+                    state["y"] - state["yhat"]
+                )
+                y_new = (
+                    state["y"] + gamma * (W @ yhat_new - yhat_new)
+                    + g_new - state["g"]
+                )
+                return {"x": x_new, "y": y_new, "g": g_new,
+                        "xhat": xhat_new, "yhat": yhat_new}
+
+        elif config.algorithm == "gradient_tracking":
             # DIGing: x_{t+1} = W x_t − η y_t;  y_{t+1} = W y_t + g_{t+1} − g_t
             # with y_0 = g_prev = 0 (first step is a pure gossip step).
             # Under Byzantine injection both gossip rounds go through the
@@ -433,13 +478,15 @@ def run(
                 w_new = live["W"] @ state["w"]
                 return {"x": num_new / w_new, "num": num_new, "w": w_new}
 
-        else:  # choco
+        else:  # choco, and compressed dsgd (the identical recursion)
             # CHOCO-SGD (Koloskova et al. 2019, Algorithm 2 matrix form):
             #   X_{t+½} = X_t − η ∇F(X_t)
             #   X̂_{t+1} = X̂_t + Q(X_{t+½} − X̂_t)      ← the transmitted bits
             #   X_{t+1} = X_{t+½} + γ (W − I) X̂_{t+1}
             # Q = identity ('none') or per-row top-k; randomized compressors
-            # are rejected above.
+            # are rejected above. Compressed dsgd routes here too: the
+            # error-feedback D-SGD step IS this update (only the lr
+            # schedule differs, and eta arrives resolved from the config).
             gamma = config.choco_gamma
             k_comp = config.compression_k
             compress = (

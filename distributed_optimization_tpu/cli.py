@@ -163,13 +163,15 @@ def build_parser() -> argparse.ArgumentParser:
                      default=_DEFAULTS.erdos_renyi_p)
     opt.add_argument("--compression", choices=COMPRESSIONS,
                      default=_DEFAULTS.compression,
-                     help="CHOCO-SGD gossip compression operator")
+                     help="error-feedback gossip compression operator "
+                          "(choco, dsgd, gradient_tracking)")
     opt.add_argument("--compression-k", type=int,
                      default=_DEFAULTS.compression_k,
                      help="coordinates kept per transmitted vector "
                           "(top_k/random_k) or quantization bits (qsgd)")
     opt.add_argument("--choco-gamma", type=float, default=_DEFAULTS.choco_gamma,
-                     help="CHOCO consensus step size")
+                     help="error-feedback consensus step size gamma "
+                          "(CHOCO and compressed dsgd/gradient_tracking)")
     opt.add_argument("--edge-drop-prob", type=float,
                      default=_DEFAULTS.edge_drop_prob,
                      help="failure injection: per-iteration probability that "
@@ -234,7 +236,8 @@ def build_parser() -> argparse.ArgumentParser:
     opt.add_argument("--clip-tau", type=float, default=_DEFAULTS.clip_tau,
                      help="fixed clipping radius for clipped_gossip "
                           "(0 = adaptive per-node radius)")
-    opt.add_argument("--robust-impl", choices=("auto", "dense", "gather"),
+    opt.add_argument("--robust-impl",
+                     choices=("auto", "dense", "gather", "fused"),
                      default=_DEFAULTS.robust_impl,
                      help="execution form of the robust rule (jax "
                           "backend): 'dense' sorts the [N,N,d] closed-"
@@ -242,9 +245,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "screens over a static [N,k_max] padded "
                           "neighbor table (O(N k_max d log k_max), "
                           "~N/k_max less work on degree-bounded graphs); "
-                          "'auto' = measured rule: gather unless the graph "
-                          "is fully connected (k_max+1 = N, where the two "
-                          "tie — docs/perf/robust_scale.json)")
+                          "'fused' runs the gather math as one pallas "
+                          "kernel (gather+screen+mix+SGD for dsgd), the "
+                          "[N,k_max,d] stack never hitting HBM; 'auto' = "
+                          "measured rule: gather unless fully connected, "
+                          "promoted to fused when eligible (static "
+                          "topology, supported rule, telemetry off — "
+                          "docs/perf/fused_robust.json)")
     opt.add_argument("--partition", choices=("sorted", "shuffled"),
                      default=_DEFAULTS.partition,
                      help="worker data split: 'sorted' = the study's "

@@ -40,6 +40,14 @@ BACKENDS = ("jax", "numpy", "cpp")
 # which derives from this constant (config stays jax-free).
 COMPRESSIONS = ("none", "top_k", "random_k", "qsgd")
 
+# Algorithms the shared error-feedback compressed-gossip machinery covers
+# (ops/compression.py::ErrorFeedbackGossip): CHOCO is the original
+# formulation; dsgd and gradient_tracking route their gossip exchanges
+# through the same per-worker estimate + compressor carry when
+# ``compression != 'none'`` (ISSUE-6 tentpole — the gather path's
+# production currency is bytes moved per round).
+COMPRESSED_ALGORITHMS = ("choco", "dsgd", "gradient_tracking")
+
 # Byzantine attack models (parallel/adversary.py derives from this constant):
 # a static, seed-deterministic set of `n_byzantine` workers replaces its
 # OUTGOING model each gossip round with an adversarial payload — sign_flip
@@ -204,16 +212,23 @@ class ExperimentConfig:
     aggregation: str = "gossip"
     robust_b: int = 0
     clip_tau: float = 0.0
-    # 'auto' | 'dense' | 'gather'. Execution form of the robust rule on the
-    # jax backend (the numpy oracle has one per-node form): 'dense' sorts
-    # the [N, N, d] closed-neighborhood tensor over the full node axis —
-    # O(N²·d·log N) regardless of topology; 'gather' precomputes a static
-    # [N, k_max] padded neighbor table, gathers neighbor models and
-    # per-incident-edge liveness bits, and screens over the k_max axis —
-    # O(N·k_max·d·log k_max), ~N/k_max-fold less work on degree-bounded
-    # graphs (measured 69-75x e2e for trimmed mean/median on an N=256
-    # ring, docs/perf/robust_scale.json). 'auto' picks from the measured
-    # crossover (see resolved_robust_impl).
+    # 'auto' | 'dense' | 'gather' | 'fused'. Execution form of the robust
+    # rule on the jax backend (the numpy oracle has one per-node form):
+    # 'dense' sorts the [N, N, d] closed-neighborhood tensor over the full
+    # node axis — O(N²·d·log N) regardless of topology; 'gather'
+    # precomputes a static [N, k_max] padded neighbor table, gathers
+    # neighbor models and per-incident-edge liveness bits, and screens
+    # over the k_max axis — O(N·k_max·d·log k_max), ~N/k_max-fold less
+    # work on degree-bounded graphs (measured 69-75x e2e for trimmed
+    # mean/median on an N=256 ring, docs/perf/robust_scale.json); 'fused'
+    # runs the gather math as ONE pallas kernel (gather + screen + mix,
+    # plus the SGD update for dsgd) so the [N, k_max, d] neighbor stack
+    # never materializes in HBM (ops/pallas_kernels.py; count rules need
+    # the closed neighborhood to fit the in-kernel sort network,
+    # k_max+1 <= FUSED_MAX_SORT_WIDTH). 'auto' picks from the measured
+    # crossover and promotes to 'fused' when the backend reports it
+    # eligible — static topology, fused-supported rule, no telemetry
+    # activity probe (see resolved_robust_impl).
     robust_impl: str = "auto"
     # Gossip schedule: 'synchronous' averages with all (surviving) neighbors
     # per iteration; 'one_peer' is Boyd-style randomized gossip — each node
@@ -288,16 +303,40 @@ class ExperimentConfig:
         if self.compression not in COMPRESSIONS:
             raise ValueError(f"Unknown compression: {self.compression}")
         if self.compression != "none":
-            if self.algorithm != "choco":
+            if self.algorithm not in COMPRESSED_ALGORITHMS:
                 raise ValueError(
                     f"compression={self.compression!r} only takes effect "
-                    "with algorithm='choco'; other algorithms exchange full "
-                    "vectors and would silently ignore it"
+                    f"with the error-feedback gossip algorithms "
+                    f"{COMPRESSED_ALGORITHMS}; other algorithms exchange "
+                    "full vectors and would silently ignore it"
                 )
             if self.compression_k <= 0:
                 raise ValueError(
                     "compression_k (coordinates kept, or qsgd bits) must be "
                     f"positive when compression={self.compression!r}"
+                )
+            if (
+                self.edge_drop_prob > 0.0
+                or self.straggler_prob > 0.0
+                or self.mttf > 0.0
+                or self.gossip_schedule != "synchronous"
+            ):
+                raise ValueError(
+                    "compressed gossip does not compose with time-varying "
+                    "graphs: a dropped exchange leaves the neighbor's copy "
+                    "of the shared error-feedback estimate stale, which "
+                    "the single shared X̂ leaf cannot represent (per-edge "
+                    "[N, N, d] staleness state would be needed) — run "
+                    "faults uncompressed, or compression on a static graph"
+                )
+            if self.attack != "none" or self.aggregation != "gossip":
+                raise ValueError(
+                    "compressed gossip does not compose with Byzantine "
+                    "injection / robust aggregation: screening operates "
+                    "on transmitted models, but error-feedback exchanges "
+                    "compressed DIFFERENCES against a shared estimate — "
+                    "a screened-out update still mutates every neighbor's "
+                    "X̂ copy, silently breaking the defense's contract"
                 )
         if self.huber_delta <= 0.0:
             raise ValueError(f"huber_delta must be positive, got {self.huber_delta}")
@@ -305,7 +344,9 @@ class ExperimentConfig:
             raise ValueError(
                 f"n_classes must be >= 2, got {self.n_classes}"
             )
-        if self.algorithm == "choco" and not 0.0 < self.choco_gamma <= 1.0:
+        if (
+            self.algorithm == "choco" or self.compression != "none"
+        ) and not 0.0 < self.choco_gamma <= 1.0:
             raise ValueError(
                 f"choco_gamma must be in (0, 1], got {self.choco_gamma}"
             )
@@ -348,7 +389,7 @@ class ExperimentConfig:
                 "aggregation rule; plain 'gossip' has no screening step and "
                 "would silently ignore it"
             )
-        if self.robust_impl not in ("auto", "dense", "gather"):
+        if self.robust_impl not in ("auto", "dense", "gather", "fused"):
             raise ValueError(f"Unknown robust impl: {self.robust_impl}")
         if self.robust_impl != "auto" and not (
             self.aggregation != "gossip" and self.robust_b > 0
@@ -540,6 +581,23 @@ class ExperimentConfig:
                     "cannot reach — replicas would silently share "
                     "compression draws; run seeds sequentially instead"
                 )
+            if self.compression != "none":
+                raise ValueError(
+                    "replicas > 1 is unsupported with compressed gossip: "
+                    "the error-feedback step derives its compressor "
+                    "stream from config.seed internally, which a batched "
+                    "per-replica seed axis cannot reach — replicas would "
+                    "silently share compression draws; run seeds "
+                    "sequentially instead"
+                )
+            if self.robust_impl == "fused":
+                raise ValueError(
+                    "replicas > 1 is incompatible with "
+                    "robust_impl='fused': the replica axis vmaps the "
+                    "whole compiled program, but the fused pallas kernel "
+                    "addresses unbatched VMEM blocks — use 'auto', "
+                    "'gather', or 'dense'"
+                )
         if self.tp_degree < 1:
             raise ValueError(
                 f"tp_degree must be >= 1, got {self.tp_degree}"
@@ -588,6 +646,13 @@ class ExperimentConfig:
                     "workers mesh axis, not a per-iteration realized "
                     "graph — run those studies on the data-parallel path"
                 )
+            if self.compression != "none":
+                raise ValueError(
+                    "tp_degree > 1 does not compose with compressed "
+                    "gossip: the TP path runs its own sharded ring "
+                    "stencil, which carries no error-feedback estimate — "
+                    "run compression studies on the data-parallel path"
+                )
             if self.replicas > 1:
                 raise ValueError(
                     "tp_degree > 1 and replicas > 1 are mutually "
@@ -631,7 +696,9 @@ class ExperimentConfig:
             return "dense"
         return "gather"
 
-    def resolved_robust_impl(self, k_max: int) -> str:
+    def resolved_robust_impl(
+        self, k_max: int, *, fused_eligible: bool = False
+    ) -> str:
         """Resolve robust_impl='auto' from the topology's maximum degree.
 
         The gather form does (k_max+1)/N of the dense sort work but adds
@@ -643,10 +710,20 @@ class ExperimentConfig:
         gather and the two measure a tie. Rule: gather iff k_max+1 < N
         (dense keeps the fully-connected case: nothing to gain, and the
         [N, k_max+1, d] gather buffer matches dense's memory anyway).
+
+        ``fused_eligible``: the BACKEND's report that the single-kernel
+        pallas form can take this configuration (static topology, a
+        fused-supported rule at this k_max, no telemetry activity probe
+        — jax_backend._bind_byzantine computes it); when set, the gather
+        branch promotes to 'fused' — same math, one VMEM-resident kernel
+        instead of gather→sort→mix ops bouncing through HBM. An explicit
+        robust_impl is never overridden.
         """
         if self.robust_impl != "auto":
             return self.robust_impl
-        return "gather" if k_max + 1 < self.n_workers else "dense"
+        if k_max + 1 >= self.n_workers:
+            return "dense"
+        return "fused" if fused_eligible else "gather"
 
     def resolved_scan_unroll(self, platform: str) -> int:
         if self.scan_unroll > 0:

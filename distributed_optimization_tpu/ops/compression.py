@@ -31,12 +31,19 @@ from __future__ import annotations
 
 import dataclasses
 from math import sqrt as np_sqrt
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from distributed_optimization_tpu.config import COMPRESSIONS
+
+# Counter-based stream tag for the (possibly randomized) compressor draws,
+# folded into the run seed: jax.random.fold_in(fold_in(key(seed), TAG), t).
+# Single source shared by CHOCO and the generalized compressed dsgd /
+# gradient-tracking steps — CHOCO's pre-refactor trajectories depend on
+# exactly this derivation, so it must not drift.
+_COMPRESSION_TAG = 0xC0C0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,3 +119,80 @@ def make_compressor(name: str, d: int, k: int = 0) -> Compressor:
         return keep_top_scored(v, jax.random.uniform(key, v.shape))
 
     return Compressor("random_k", apply_randk, 2.0 * k, k / d)
+
+
+# ------------------------------------------------- error-feedback machinery
+
+
+def compression_key(seed: int, t, round: int = 0):
+    """The counter-based PRNG key for iteration ``t``'s compressor draw.
+
+    ``round`` distinguishes multiple exchanges within one iteration
+    (gradient tracking compresses both its x and y gossip rounds); round 0
+    is EXACTLY the pre-refactor CHOCO derivation, so single-exchange
+    algorithms (choco, compressed dsgd) keep their historical draws.
+    """
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.key(seed), _COMPRESSION_TAG), t
+    )
+    if round:
+        key = jax.random.fold_in(key, round)
+    return key
+
+
+@dataclasses.dataclass(frozen=True)
+class ErrorFeedbackGossip:
+    """CHOCO-style error-feedback compressed gossip, algorithm-agnostic.
+
+    Generalized out of ``algorithms/choco.py`` (ISSUE-6 tentpole) so
+    D-SGD and gradient tracking can route their gossip exchanges through
+    the same machinery. Each worker carries a public estimate x̂_i (the
+    error-accumulator memory) that every neighbor holds a copy of; one
+    exchange transmits only q_i = Q(v_i − x̂_i):
+
+        x̂⁺ = x̂ + Q(v − x̂)                ← the ONLY bits on the wire
+        v⁺  = v + γ [(W − I) X̂⁺]          (gossip over the estimates)
+
+    The compression error v − x̂⁺ stays in the carry and is re-offered to
+    the compressor next round — the error-feedback property that keeps
+    the scheme convergent for any contraction operator (Koloskova, Stich
+    & Jaggi '19). Identity compression at γ = 1 makes one exchange exactly
+    the plain W-mix (v⁺ = W v), which is why uncompressed trajectories
+    are unaffected. ``floats_per_edge`` (the compressor's payload) is the
+    comms-accounting hook the backends consume.
+    """
+
+    compressor: Compressor
+    gamma: float
+
+    @property
+    def floats_per_edge(self) -> float:
+        return self.compressor.floats_per_edge
+
+    def init(self, x0) -> jax.Array:
+        """The estimate memory starts at 0 — every copy trivially agrees."""
+        return jnp.zeros_like(x0)
+
+    def exchange(
+        self, key, v, memory, mix: Callable
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One compressed gossip exchange: ``(v⁺, x̂⁺)``.
+
+        ``mix``: the backend's x → W x collective (the estimates gossip
+        through whatever mixing implementation the run selected). Ops are
+        term-for-term the pre-refactor CHOCO step — trajectories are
+        bitwise-unchanged (pinned in tests/test_choco.py).
+        """
+        q = self.compressor.apply(key, v - memory)
+        memory_new = memory + q
+        v_new = v + self.gamma * (mix(memory_new) - memory_new)
+        return v_new, memory_new
+
+
+def make_error_feedback(
+    name: str, d: int, k: int, gamma: float
+) -> ErrorFeedbackGossip:
+    """Build the shared error-feedback exchange for d-dimensional rows."""
+    return ErrorFeedbackGossip(
+        compressor=make_compressor(name, d, k), gamma=float(gamma)
+    )

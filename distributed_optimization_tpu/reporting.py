@@ -162,6 +162,19 @@ def _health_section(records) -> list[str]:
             )
         if h.get("clip_frac_mean"):
             parts.append(f"screened msgs {h['clip_frac_mean']:.1%}")
+        comms = h.get("comms")
+        if comms is not None:
+            # Bytes moved per ITERATION (realized mean; both gossip
+            # rounds for two-mix algorithms) — the number a compression
+            # operator exists to shrink; tagged with the operator so a
+            # 'top_k' win reads directly off the report.
+            tag = (
+                f" ({comms['compression']})"
+                if comms.get("compression", "none") != "none" else ""
+            )
+            parts.append(
+                f"floats/iter {comms['floats_per_iteration_mean']:.4g}{tag}"
+            )
         if parts:
             lines.append(f"  {rec.label:<26}" + ", ".join(parts))
     return lines

@@ -70,6 +70,12 @@ def main() -> None:
         n_informative_features=6, n_iterations=4000, local_batch_size=100,
         eval_every=500, partition="shuffled",
     )
+    # This artifact documents the GATHER robust path's breakdown table
+    # (PR 3); pin it explicitly — since PR 6 a bare 'auto' on these
+    # static-ring cells promotes to the fused pallas kernel, which would
+    # silently change what a regen measures vs what the config string
+    # claims (the fused path's own evidence is docs/perf/fused_robust.json).
+    ROBUST_IMPL = "gather"
     # Attackers, per-neighborhood budget (ring min degree 2 => b <= 1),
     # sign-flip scale. f=6 under seed 203 places <= 1 attacker in every
     # honest closed ring neighborhood — within the b=1 budget everywhere;
@@ -78,15 +84,20 @@ def main() -> None:
     F, B, S = 6, 1, 5.0
 
     def attacked(attack, scale=S, f=F, **kw):
+        if kw.get("robust_b", 0) > 0:
+            kw.setdefault("robust_impl", ROBUST_IMPL)
         return base.replace(
             attack=attack, n_byzantine=f, attack_scale=scale, **kw
         )
 
+    def defended(**kw):
+        return base.replace(robust_impl=ROBUST_IMPL, **kw)
+
     variants = {
         "attack_free": base,
-        "tm_b1_no_attack": base.replace(aggregation="trimmed_mean", robust_b=B),
-        "median_b1_no_attack": base.replace(aggregation="median", robust_b=B),
-        "clip_b1_no_attack": base.replace(
+        "tm_b1_no_attack": defended(aggregation="trimmed_mean", robust_b=B),
+        "median_b1_no_attack": defended(aggregation="median", robust_b=B),
+        "clip_b1_no_attack": defended(
             aggregation="clipped_gossip", robust_b=B
         ),
         "tm_b0_no_attack": base.replace(aggregation="trimmed_mean", robust_b=0),
@@ -193,8 +204,10 @@ def main() -> None:
         "device": str(jax.devices()[0]),
         "config": (
             "logistic N=64 ring T=4k shuffled partition (gather robust "
-            f"path via robust_impl=auto); f={F} Byzantine of 64, "
-            f"per-neighborhood budget b={B}, sign-flip scale {S}"
+            f"path, robust_impl={ROBUST_IMPL!r} pinned — since PR 6 "
+            "'auto' promotes these static cells to the fused kernel, "
+            f"whose evidence is fused_robust.json); f={F} Byzantine of "
+            f"64, per-neighborhood budget b={B}, sign-flip scale {S}"
         ),
         "note": (
             "final honest-suboptimality gap f(x_bar_honest) - f* per "

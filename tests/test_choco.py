@@ -171,12 +171,18 @@ def test_choco_rejects_edge_faults(data):
     # A dropped edge means the neighbor's estimate copy goes stale, which the
     # shared-X̂ simulation cannot represent — the combination must raise
     # rather than report fault-free convergence with discounted bandwidth.
+    # Compressed configs now fail at CONSTRUCTION (the ISSUE-6
+    # generalization rejects compression × time-varying graphs for every
+    # error-feedback algorithm); identity-compression CHOCO still carries
+    # the shared estimate, so the backend rejects it with the
+    # per-algorithm rationale as before.
     ds, f_opt = data
+    with pytest.raises(ValueError, match="does not compose with time-vary"):
+        CFG.replace(compression="top_k", compression_k=4,
+                    choco_gamma=0.2, edge_drop_prob=0.2)
     with pytest.raises(ValueError, match="not faithful"):
         jax_backend.run(
-            CFG.replace(compression="top_k", compression_k=4,
-                        choco_gamma=0.2, edge_drop_prob=0.2),
-            ds, f_opt,
+            CFG.replace(choco_gamma=0.2, edge_drop_prob=0.2), ds, f_opt,
         )
 
 
@@ -188,6 +194,12 @@ def test_config_validation():
     with pytest.raises(ValueError, match="choco_gamma"):
         ExperimentConfig(algorithm="choco", choco_gamma=0.0)
     # Compression on a full-vector algorithm would be silently ignored;
-    # config rejects the combination outright.
+    # config rejects the combination outright (dsgd/gradient_tracking now
+    # route through the shared error-feedback machinery and ACCEPT it —
+    # tests/test_compressed_gossip.py).
     with pytest.raises(ValueError, match="only takes effect"):
-        ExperimentConfig(algorithm="dsgd", compression="top_k", compression_k=3)
+        ExperimentConfig(algorithm="extra", compression="top_k",
+                         compression_k=3)
+    ExperimentConfig(algorithm="dsgd", compression="top_k", compression_k=3)
+    ExperimentConfig(algorithm="gradient_tracking", compression="qsgd",
+                     compression_k=4)
