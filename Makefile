@@ -3,8 +3,9 @@
 
 PY ?= python
 
-.PHONY: test smoke bench-byzantine bench-churn bench-robust-scale \
-	bench-sweep bench-compute bench-telemetry bench-fused
+.PHONY: test smoke serve-smoke bench-byzantine bench-churn \
+	bench-robust-scale bench-sweep bench-compute bench-telemetry \
+	bench-fused bench-serving
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -12,14 +13,22 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
 # Fast robustness smoke: fault-injection + churn + Byzantine + gather-
-# aggregation + replica-batched-parity + telemetry suites, first failure
-# stops, strict collection (no marker typos, no swallowed import errors).
+# aggregation + replica-batched-parity + telemetry + serving suites,
+# first failure stops, strict collection (no marker typos, no swallowed
+# import errors).
 smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest -q -m 'not slow' -x \
 		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py \
 		tests/test_robust_gather.py tests/test_fused_robust.py \
 		tests/test_compressed_gossip.py tests/test_batch.py \
-		tests/test_telemetry.py
+		tests/test_telemetry.py tests/test_serving.py
+
+# End-to-end serving smoke over real HTTP (docs/SERVING.md): boot the
+# daemon, submit 3 requests (2 structurally identical -> ONE compile via
+# one coalesced cohort, 1 outlier), assert cache/cohort facts + served
+# responses match a direct run, shut down cleanly over the wire.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/serve_smoke.py
 
 # Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
 bench-byzantine:
@@ -60,3 +69,10 @@ bench-telemetry:
 # and bytes-vs-gap envelopes for {none,top_k,qsgd} x {dsgd,gt}).
 bench-fused:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_fused_robust.py
+
+# Regenerate the serving-layer evidence (docs/perf/serving.json:
+# executable-cache warm-vs-cold submit->start latency >= 10x floor,
+# coalesced-cohort throughput >= 2.5x one-at-a-time on this CPU
+# container, mixed-workload replay stats, f64 parity re-check).
+bench-serving:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_serving.py

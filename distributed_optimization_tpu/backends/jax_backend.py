@@ -50,6 +50,11 @@ from distributed_optimization_tpu.ops.robust_aggregation import (
     validate_budget,
 )
 from distributed_optimization_tpu.telemetry import cost_from_lowered
+from distributed_optimization_tpu.serving.cache import (
+    batch_cache_key,
+    resolve_cache,
+    sequential_cache_key,
+)
 from distributed_optimization_tpu.parallel.adversary import (
     make_adversary,
     make_byzantine_mixing,
@@ -948,8 +953,22 @@ def run(
     return_state: bool = False,
     hoisted_min_ratio: Optional[float] = None,
     eval_hoist_limit: Optional[int] = None,
+    executable_cache=None,
 ) -> BackendRunResult:
     """Run one experiment on the JAX backend; returns histories + final models.
+
+    ``executable_cache`` controls AOT compile reuse (docs/SERVING.md): the
+    default ``None`` consults the process-wide
+    ``serving.cache.process_executable_cache()`` — a repeated identical run
+    in one process re-executes the cached compiled program instead of
+    re-tracing and re-compiling it (bitwise-identical results; the cache
+    key pins the full config, f*, data/mesh signatures and the jax
+    environment, so anything that could change the program misses).
+    ``False`` forces a cold compile (benches that MEASURE compile cost use
+    this); an ``ExecutableCache`` instance scopes reuse explicitly (the
+    serving layer passes its own). Only the fused no-checkpoint path
+    caches; the chunked/segmented forms always compile. On a cache hit
+    ``history.compile_seconds`` is 0.0.
 
     ``hoisted_min_ratio`` / ``eval_hoist_limit`` override the module-level
     eval-cadence-form defaults (HOISTED_MIN_RATIO / EVAL_HOIST_LIMIT) for
@@ -983,6 +1002,7 @@ def run(
             return_state=return_state,
             hoisted_min_ratio=hoisted_min_ratio,
             eval_hoist_limit=eval_hoist_limit,
+            executable_cache=executable_cache,
         )
 
 
@@ -1057,6 +1077,7 @@ def _run(
     return_state: bool = False,
     hoisted_min_ratio: Optional[float] = None,
     eval_hoist_limit: Optional[int] = None,
+    executable_cache=None,
 ) -> BackendRunResult:
     """Backend implementation (see ``run``).
 
@@ -1514,18 +1535,52 @@ def _run(
                 t0_const = jnp.asarray(0, dtype=jnp.int32)
                 return make_seg_scan(n_evals)(state_init, t0_const, data)
 
-            # AOT compile so compile time and steady-state execution are
-            # separable (jax.profiler-style phase split, SURVEY.md §5.1).
-            t0 = time.perf_counter()
-            with jax.default_matmul_precision(config.matmul_precision):
-                lowered = jax.jit(run_scan).lower(state0, data_args)
-                cost = (
-                    cost_from_lowered(lowered) if config.telemetry else None
+            # AOT executable reuse (docs/SERVING.md): the sequential
+            # program bakes its PRNG key, scalars and f*, so the key is
+            # the FULL config hash + call-level trace facts — a hit means
+            # the identical experiment ran before in this process, and
+            # re-executing its compiled program is bitwise the same.
+            exec_cache = resolve_cache(executable_cache)
+            cache_key = cached = None
+            if exec_cache is not None:
+                cache_key = sequential_cache_key(
+                    config, f_opt, device_data,
+                    schedule_signature=(
+                        tuple(batch_schedule.shape)
+                        if batch_schedule is not None else None
+                    ),
+                    collect_metrics=collect_metrics,
+                    mesh_signature=(
+                        tuple(str(d) for d in mesh.devices.flat)
+                        if mesh is not None else None
+                    ),
+                    hoisted_min_ratio=hoisted_min_ratio,
+                    eval_hoist_limit=eval_hoist_limit,
                 )
-                compiled = lowered.compile()
-            compile_seconds = (
-                time.perf_counter() - t0 if measure_compile else 0.0
-            )
+                cached = exec_cache.get(cache_key)
+            if cached is not None:
+                compiled = cached.executable
+                cost = cached.cost if config.telemetry else None
+                compile_seconds = 0.0
+            else:
+                # AOT compile so compile time and steady-state execution
+                # are separable (jax.profiler-style phase split, SURVEY.md
+                # §5.1).
+                t0 = time.perf_counter()
+                with jax.default_matmul_precision(config.matmul_precision):
+                    lowered = jax.jit(run_scan).lower(state0, data_args)
+                    cost = (
+                        cost_from_lowered(lowered)
+                        if config.telemetry else None
+                    )
+                    compiled = lowered.compile()
+                cold_seconds = time.perf_counter() - t0
+                compile_seconds = cold_seconds if measure_compile else 0.0
+                if exec_cache is not None:
+                    exec_cache.put(
+                        cache_key, compiled, cost=cost,
+                        compile_seconds=cold_seconds,
+                    )
 
             t1 = time.perf_counter()
             final_state, ys = compiled(state0, data_args)
@@ -1668,6 +1723,59 @@ class BatchRunResult:
     final_states: dict
 
 
+def batch_unsupported_reason(config) -> Optional[str]:
+    """Why ``run_batch`` cannot execute this config, or None when it can.
+
+    The single source of the batched path's rejection logic:
+    ``_run_batch`` raises exactly these strings, and the serving
+    coalescer (``serving/coalescer.py``) consults the same function to
+    route unbatchable requests down the sequential fallback instead of
+    discovering the rejection mid-cohort.
+    """
+    if config.backend != "jax":
+        return (
+            "replica-batched execution vmaps the jax scan; backend="
+            f"{config.backend!r} runs one trajectory at a time — use "
+            "backend='jax' or loop single runs"
+        )
+    if config.algorithm == "choco":
+        return (
+            "run_batch does not support 'choco': its step rule derives "
+            "the compressor stream from config.seed internally, which the "
+            "batched per-replica seed axis cannot reach — replicas would "
+            "silently share compression draws"
+        )
+    if config.mixing_impl in ("shard_map", "pallas"):
+        return (
+            f"run_batch is incompatible with mixing_impl="
+            f"{config.mixing_impl!r}: shard_map stencils pin a device "
+            "mesh and the pallas kernels address unbatched VMEM blocks — "
+            "use 'auto', 'dense', 'stencil', or 'sparse'"
+        )
+    if config.robust_impl == "fused":
+        return (
+            "run_batch is incompatible with robust_impl='fused': the "
+            "fused pallas kernel addresses unbatched VMEM blocks — use "
+            "'auto', 'gather', or 'dense' (auto never promotes to fused "
+            "inside the replica batch)"
+        )
+    if config.compression != "none":
+        return (
+            "run_batch does not support compressed gossip: the "
+            "error-feedback step derives its compressor stream from "
+            "config.seed internally, which the batched per-replica seed "
+            "axis cannot reach — replicas would silently share "
+            "compression draws"
+        )
+    if config.tp_degree > 1:
+        return (
+            "run_batch and tp_degree > 1 are mutually exclusive: the TP "
+            "path pins a 2-D (workers, model) device mesh that the "
+            "replica vmap axis cannot wrap"
+        )
+    return None
+
+
 def run_batch(
     config,
     dataset: HostDataset,
@@ -1679,6 +1787,7 @@ def run_batch(
     measure_compile: bool = True,
     state0=None,
     t0: int = 0,
+    executable_cache=None,
 ) -> BatchRunResult:
     """Run R replicas of ``config`` as one vmapped XLA program.
 
@@ -1694,9 +1803,18 @@ def run_batch(
     Structural axes (topology, n_workers, algorithm, ...) cannot batch —
     they change the traced program — and are rejected; so are the config
     combinations whose execution cannot wrap in vmap (shard_map/pallas
-    mixing, tensor parallelism, choco's internal seed derivation). The
-    batched program runs unsharded (the replica axis fills the chip
-    instead of the worker mesh) and always uses the fused flat scan.
+    mixing, tensor parallelism, choco's internal seed derivation) — see
+    ``batch_unsupported_reason``. The batched program runs unsharded (the
+    replica axis fills the chip instead of the worker mesh) and always
+    uses the fused flat scan.
+
+    ``executable_cache`` controls AOT compile reuse (docs/SERVING.md; same
+    convention as ``run``): seeds, swept scalars, fault timelines,
+    Byzantine masks and f* are traced INPUTS of the batched program, so a
+    cached executable is reusable across seed AND sweep variants of one
+    structural config — the serving layer's whole amortization story. The
+    default ``None`` consults the process-wide cache; ``False`` forces a
+    cold compile.
     """
     from distributed_optimization_tpu.backends.base import x64_scope
 
@@ -1705,6 +1823,7 @@ def run_batch(
             config, dataset, f_opt, seeds=seeds, sweep=sweep,
             collect_metrics=collect_metrics,
             measure_compile=measure_compile, state0=state0, t0=t0,
+            executable_cache=executable_cache,
         )
 
 
@@ -1719,6 +1838,7 @@ def _run_batch(
     measure_compile: bool,
     state0,
     t0: int,
+    executable_cache=None,
 ) -> BatchRunResult:
     from distributed_optimization_tpu.config import SWEEPABLE_FIELDS
     from distributed_optimization_tpu.parallel.adversary import (
@@ -1754,41 +1874,12 @@ def _run_batch(
                 "replicas; every swept axis must match the seed vector's "
                 "length"
             )
-    if config.algorithm == "choco":
-        raise ValueError(
-            "run_batch does not support 'choco': its step rule derives "
-            "the compressor stream from config.seed internally, which the "
-            "batched per-replica seed axis cannot reach — replicas would "
-            "silently share compression draws"
-        )
-    if config.mixing_impl in ("shard_map", "pallas"):
-        raise ValueError(
-            f"run_batch is incompatible with mixing_impl="
-            f"{config.mixing_impl!r}: shard_map stencils pin a device "
-            "mesh and the pallas kernels address unbatched VMEM blocks — "
-            "use 'auto', 'dense', 'stencil', or 'sparse'"
-        )
-    if config.robust_impl == "fused":
-        raise ValueError(
-            "run_batch is incompatible with robust_impl='fused': the "
-            "fused pallas kernel addresses unbatched VMEM blocks — use "
-            "'auto', 'gather', or 'dense' (auto never promotes to fused "
-            "inside the replica batch)"
-        )
-    if config.compression != "none":
-        raise ValueError(
-            "run_batch does not support compressed gossip: the "
-            "error-feedback step derives its compressor stream from "
-            "config.seed internally, which the batched per-replica seed "
-            "axis cannot reach — replicas would silently share "
-            "compression draws"
-        )
-    if config.tp_degree > 1:
-        raise ValueError(
-            "run_batch and tp_degree > 1 are mutually exclusive: the TP "
-            "path pins a 2-D (workers, model) device mesh that the "
-            "replica vmap axis cannot wrap"
-        )
+    # The backend field routes dispatch (run_algorithm_batch), not this
+    # entry point — a direct call compiles on jax regardless, so only the
+    # execution-structure rejections apply here.
+    unbatchable = batch_unsupported_reason(config.replace(backend="jax"))
+    if unbatchable is not None:
+        raise ValueError(unbatchable)
     if t0 < 0:
         raise ValueError(f"t0 must be >= 0, got {t0}")
     if not get_algorithm(config.algorithm).is_decentralized and (
@@ -1961,10 +2052,17 @@ def _run_batch(
         )
 
     # --- data + initial state (unsharded; replica axis fills the chip) --
+    # f* rides along as a TRACED scalar (replica-shared), not a closure
+    # constant like the sequential path bakes: the executable cache reuses
+    # one compiled batched program across requests whose datasets — and
+    # therefore optima — differ (docs/SERVING.md). Cast to the run dtype
+    # up front, exactly the cast the weak Python float would get at the
+    # subtraction, so traced-vs-baked trajectories stay bitwise.
     data_args = {
         "X": jnp.asarray(device_data.X),
         "y": jnp.asarray(device_data.y),
         "n_valid": jnp.asarray(device_data.n_valid),
+        "f_opt": jnp.asarray(f_opt, dtype=device_data.X.dtype),
     }
     x0 = jnp.zeros((n, d_model), dtype=device_data.X.dtype)
     st0 = algo.init(
@@ -2060,7 +2158,7 @@ def _run_batch(
             degrees=degrees, mix_op=mix_op, faulty=faulty,
             byz_mix=byz_mix, adversary=adversary, honest_w=honest_w,
             fused_mix_step=None, full_objective=full_objective,
-            f_opt=f_opt, collect_metrics=collect_metrics,
+            f_opt=data["f_opt"], collect_metrics=collect_metrics,
             track_consensus=track_consensus, edge_payload=edge_payload,
             telemetry=config.telemetry, robust_activity=robust_activity,
             static_degree_sum=static_degree_sum,
@@ -2086,20 +2184,42 @@ def _run_batch(
     batched = jax.vmap(replica_scan, in_axes=(rp_axes, 0, None, None))
     t0_dev = jnp.asarray(t0, dtype=jnp.int32)
 
-    t_c = time.perf_counter()
-    with jax.default_matmul_precision(config.matmul_precision):
-        lowered = jax.jit(batched).lower(rp, state0_R, t0_dev, data_args)
-        cost = cost_from_lowered(lowered) if config.telemetry else None
-        if cost is not None:
-            # The analysis covers the WHOLE R-replica vmapped program; the
-            # same dict is attached to every per-replica history, so record
-            # the replica count rather than letting a consumer read R runs'
-            # FLOPs as one run's (divide by program_replicas for an
-            # approximate per-replica share — shared data reads make an
-            # exact split ill-defined).
-            cost = {**cost, "program_replicas": float(R)}
-        compiled = lowered.compile()
-    compile_seconds = time.perf_counter() - t_c if measure_compile else 0.0
+    # AOT executable reuse (docs/SERVING.md): the batched program takes
+    # seeds/sweeps/timelines/f* as data, so its cache key is the config's
+    # STRUCTURAL hash + call-level trace facts — one cached executable
+    # serves every seed/sweep variant of this structural config at this R.
+    exec_cache = resolve_cache(executable_cache)
+    cache_key = cached = None
+    if exec_cache is not None:
+        cache_key = batch_cache_key(
+            config, device_data, R=R, t0=t0, rp_keys=rp.keys(),
+            sweep_fields=sweep.keys(), collect_metrics=collect_metrics,
+        )
+        cached = exec_cache.get(cache_key)
+    if cached is not None:
+        compiled = cached.executable
+        cost = cached.cost if config.telemetry else None
+        compile_seconds = 0.0
+    else:
+        t_c = time.perf_counter()
+        with jax.default_matmul_precision(config.matmul_precision):
+            lowered = jax.jit(batched).lower(rp, state0_R, t0_dev, data_args)
+            cost = cost_from_lowered(lowered) if config.telemetry else None
+            if cost is not None:
+                # The analysis covers the WHOLE R-replica vmapped program;
+                # the same dict is attached to every per-replica history,
+                # so record the replica count rather than letting a
+                # consumer read R runs' FLOPs as one run's (divide by
+                # program_replicas for an approximate per-replica share —
+                # shared data reads make an exact split ill-defined).
+                cost = {**cost, "program_replicas": float(R)}
+            compiled = lowered.compile()
+        cold_seconds = time.perf_counter() - t_c
+        compile_seconds = cold_seconds if measure_compile else 0.0
+        if exec_cache is not None:
+            exec_cache.put(
+                cache_key, compiled, cost=cost, compile_seconds=cold_seconds,
+            )
 
     t_r = time.perf_counter()
     final_states, ys = compiled(rp, state0_R, t0_dev, data_args)

@@ -264,6 +264,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "draws independently of --seed (-1 = follow "
                           "--seed); replicated runs pin it automatically "
                           "so every replica shares one graph instance")
+    opt.add_argument("--data-seed", type=int, default=_DEFAULTS.data_seed,
+                     help="pin the DATASET's random draws independently "
+                          "of --seed (-1 = follow --seed); with it "
+                          "pinned, runs that differ only in --seed share "
+                          "one problem instance — the serving layer "
+                          "coalesces such requests into one batched "
+                          "program (docs/SERVING.md)")
     opt.add_argument("--replicas", type=int, default=_DEFAULTS.replicas,
                      help="run this many seed replicates (seed, seed+1, "
                           "...) as ONE vmapped jax program and report "
@@ -394,6 +401,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         choco_gamma=args.choco_gamma,
         seed=args.seed,
         topology_seed=args.topology_seed,
+        data_seed=args.data_seed,
         replicas=args.replicas,
         tp_degree=args.tp,
         eval_every=args.eval_every,

@@ -11,6 +11,8 @@ names so configs round-trip with the reference's experiment setup.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import math
 from typing import Any
 
@@ -76,6 +78,12 @@ AGGREGATIONS = ("gossip", "trimmed_mean", "median", "clipped_gossip")
 # fields (topology, n_workers, algorithm, ...) change the traced program
 # itself and are rejected with a pointer to running separate sweeps.
 SWEEPABLE_FIELDS = ("learning_rate_eta0", "clip_tau", "edge_drop_prob")
+
+# Topologies whose edge structure is a random draw from a seed; only these
+# consume ``resolved_topology_seed`` when building the graph, so only they
+# contribute it to the structural hash below (a ring is the same compiled
+# program whatever the seed says).
+RANDOM_TOPOLOGIES = ("erdos_renyi", "directed_erdos_renyi")
 
 # Default Huber transition point δ: fixed at the synthetic data's noise scale
 # (make_regression noise=10.0, utils/data.py), i.e. the kink sits at ~1σ of the
@@ -159,6 +167,17 @@ class ExperimentConfig:
     # replica exactly equivalent to a sequential run of its per-replica
     # config. Deterministic topologies ignore it.
     topology_seed: int = -1
+    # Seed for the DATASET's random draws (sklearn generators + the
+    # 'shuffled' partition) when it should NOT follow ``seed``: −1
+    # (default) derives the data from ``seed`` as the reference does; >= 0
+    # pins the problem instance independently, so seed variants name runs
+    # over ONE shared dataset. This is the serving layer's coalescing knob
+    # (docs/SERVING.md): requests that differ only in ``seed`` can share a
+    # run_batch cohort — and therefore one compiled program execution —
+    # only when they agree on the dataset, which a pinned data_seed makes
+    # explicit (the same contract the CLI's --seeds path has always used
+    # implicitly by generating the dataset from the base seed once).
+    data_seed: int = -1
     eval_every: int = 1  # full-data objective eval cadence (reference: every iter)
     erdos_renyi_p: float = 0.4  # edge probability for the ER topology
     # Failure injection (SURVEY.md §5.3): per-iteration iid probability that
@@ -551,6 +570,11 @@ class ExperimentConfig:
                 f"topology_seed must be -1 (follow seed) or >= 0, got "
                 f"{self.topology_seed}"
             )
+        if self.data_seed < -1:
+            raise ValueError(
+                f"data_seed must be -1 (follow seed) or >= 0, got "
+                f"{self.data_seed}"
+            )
         if self.replicas < 1:
             raise ValueError(
                 f"replicas must be >= 1, got {self.replicas}"
@@ -671,6 +695,56 @@ class ExperimentConfig:
         """The seed random topologies actually build from: ``topology_seed``
         when pinned (>= 0), else ``seed``."""
         return self.topology_seed if self.topology_seed >= 0 else self.seed
+
+    def resolved_data_seed(self) -> int:
+        """The seed the dataset actually generates from: ``data_seed`` when
+        pinned (>= 0), else ``seed``."""
+        return self.data_seed if self.data_seed >= 0 else self.seed
+
+    def structural_dict(self) -> dict[str, Any]:
+        """The canonical view of everything that changes the TRACED program.
+
+        Two configs with equal structural dicts compile to the same XLA
+        program shape on the replica-batched path, where the per-replica
+        scalars are data: ``seed`` feeds PRNG keys / fault timelines /
+        Byzantine sets (all traced inputs), ``data_seed`` only picks the
+        dataset VALUES (also traced inputs), and the ``SWEEPABLE_FIELDS``
+        (eta0, clip_tau, edge_drop_prob) enter as swept per-replica scalars.
+        Everything else — and the structural BOUNDARIES inside the
+        sweepables — stays: ``edge_drop_prob == 0`` means no fault
+        machinery is traced at all, and ``clip_tau == 0`` selects the
+        adaptive-radius clipping program, so those zero/nonzero indicators
+        are recorded even though the values are not. Random topologies
+        contribute their resolved seed (the realized graph is baked into
+        the program as mixing constants); deterministic topologies do not.
+
+        This is the serving layer's cache/coalescing identity
+        (docs/SERVING.md): the executable cache keys compiled programs on
+        ``structural_hash()`` (plus call-level facts like the cohort size
+        and data shapes), and the request coalescer groups pending requests
+        whose structural hash AND dataset agree into one ``run_batch``
+        cohort.
+        """
+        d = self.to_dict()
+        d["seed"] = None
+        d["data_seed"] = None
+        for f in SWEEPABLE_FIELDS:
+            d[f] = None
+        d["topology_seed"] = (
+            self.resolved_topology_seed()
+            if self.topology in RANDOM_TOPOLOGIES
+            else None
+        )
+        d["edge_faults_traced"] = self.edge_drop_prob > 0.0
+        d["clip_tau_fixed"] = self.clip_tau > 0.0
+        return d
+
+    def structural_hash(self) -> str:
+        """Stable content hash of ``structural_dict`` (sorted-key JSON,
+        sha256, 16 hex chars — the same convention as telemetry's
+        ``config_hash``)."""
+        blob = json.dumps(self.structural_dict(), sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     def replica_seeds(self) -> list[int]:
         """The per-replica seed vector a replicated run sweeps: seed,

@@ -22,7 +22,8 @@ def _fmt_sci(v: float) -> str:
     return f"{v:.3e}"
 
 
-def format_report(records, config, f_opt: float, phases=None) -> str:
+def format_report(records, config, f_opt: float, phases=None,
+                  serving=None) -> str:
     """Render the numerical-results table for a list of ExperimentRecords.
 
     ``phases``: optional {name: seconds} wall-clock phase accounting
@@ -30,6 +31,11 @@ def format_report(records, config, f_opt: float, phases=None) -> str:
     flight-recorder state (``config.telemetry``) additionally get a
     run-health section: worst-worker gradient norm, non-finite counts, and
     realized-vs-nominal connectivity (docs/OBSERVABILITY.md).
+
+    ``serving``: optional executable-cache / coalescing counters (the
+    Simulator passes the process cache's stats once it has recorded a hit;
+    the serving layer passes ``SimulationService.stats()``) rendered as a
+    one-line serving summary (docs/SERVING.md).
     """
     lines = [
         "=" * 78,
@@ -126,6 +132,9 @@ def format_report(records, config, f_opt: float, phases=None) -> str:
     if health_lines:
         lines.append("run health (telemetry):")
         lines += health_lines
+    serving_line = _serving_line(serving)
+    if serving_line:
+        lines.append(serving_line)
     if phases:
         total = sum(phases.values())
         lines.append("phases:")
@@ -133,6 +142,36 @@ def format_report(records, config, f_opt: float, phases=None) -> str:
             share = secs / total if total > 0 else 0.0
             lines.append(f"  {name:<12}{secs:>10.3f}s{share:>8.1%}")
     return "\n".join(lines)
+
+
+def _serving_line(serving) -> Optional[str]:
+    """One-line executable-cache / coalescing summary (docs/SERVING.md).
+
+    Accepts either a bare ``ExecutableCache.stats()`` dict or a full
+    ``SimulationService.stats()`` dict (cache nested under "cache" with
+    cohort/queue counters alongside); returns None when there is nothing
+    to report.
+    """
+    if not serving:
+        return None
+    cache = serving.get("cache", serving)
+    if not cache or (cache.get("hits", 0) + cache.get("misses", 0)) == 0:
+        return None
+    parts = [
+        f"cache {cache['hits']} hit{'s' if cache['hits'] != 1 else ''} / "
+        f"{cache['misses']} miss{'es' if cache['misses'] != 1 else ''}",
+        f"{cache.get('compile_seconds_saved', 0.0):.1f}s compile saved",
+    ]
+    cohorts = serving.get("cohorts")
+    if cohorts and cohorts.get("count"):
+        parts.append(
+            f"{cohorts['count']} cohort{'s' if cohorts['count'] != 1 else ''}"
+            f" (mean R={cohorts['mean_size']:.1f})"
+        )
+    qw = serving.get("queue_wait_s")
+    if qw and qw.get("mean") is not None:
+        parts.append(f"mean queue wait {qw['mean'] * 1e3:.0f}ms")
+    return "serving: " + ", ".join(parts)
 
 
 def _health_section(records) -> list[str]:

@@ -1,0 +1,12 @@
+"""``python -m distributed_optimization_tpu.serve`` — the serving daemon.
+
+Boots the stdlib HTTP front end over ``serving.SimulationService``:
+config JSON in, RunTrace manifest JSONL out, with AOT executable caching
+and request coalescing (docs/SERVING.md has the protocol and a curl
+example). All flags live on ``serving.daemon.main``.
+"""
+
+from distributed_optimization_tpu.serving.daemon import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

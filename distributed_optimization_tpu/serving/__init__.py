@@ -1,0 +1,48 @@
+"""Simulation-as-a-service (ISSUE-7 tentpole; docs/SERVING.md).
+
+Turns the simulator into a request-driven service built from three layers:
+
+- ``serving.cache`` — the AOT executable cache: compiled XLA programs keyed
+  by the config's STRUCTURAL hash (``ExperimentConfig.structural_hash``), so
+  sweep/seed variants of one program reuse one ``Lowered``/compiled
+  executable instead of paying the multi-second whole-run compile per
+  request (docs/PERF.md §3). LRU by entry count + estimated bytes, with
+  hit/miss/compile-seconds-saved counters. A process-wide default instance
+  is consulted by ``backends/jax_backend.run``/``run_batch`` unless a caller
+  opts out.
+- ``serving.coalescer`` — groups structurally identical pending requests
+  into one ``run_batch`` cohort (per-request sweepable scalars ride the
+  replica axis as traced data) and slices each request's trajectory back
+  out; unbatchable configs fall back to sequential ``run``.
+- ``serving.service`` / ``serving.daemon`` — the front end: a
+  ``SimulationService`` Python API (submit/result/stats, wait-window
+  coalescing, bounded queue) and a stdlib-only HTTP daemon
+  (``python -m distributed_optimization_tpu.serve``) that takes config JSON
+  in and streams ``RunTrace`` manifests back.
+
+This ``__init__`` stays import-light on purpose: ``backends/jax_backend``
+imports ``serving.cache`` at module load, so pulling the service/daemon
+(and through them the backends) in here would be a cycle.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "ExecutableCache": "distributed_optimization_tpu.serving.cache",
+    "process_executable_cache": "distributed_optimization_tpu.serving.cache",
+    "structural_group_key": "distributed_optimization_tpu.serving.coalescer",
+    "SimulationService": "distributed_optimization_tpu.serving.service",
+    "ServingError": "distributed_optimization_tpu.serving.service",
+    "ServingOptions": "distributed_optimization_tpu.serving.service",
+    "ServingDaemon": "distributed_optimization_tpu.serving.daemon",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,335 @@
+"""Stdlib-only HTTP daemon over ``SimulationService`` (docs/SERVING.md).
+
+``python -m distributed_optimization_tpu.serve`` boots it. No new runtime
+dependencies: ``http.server`` + JSON lines. Protocol (all bodies JSON;
+manifests are STRICT JSON via the telemetry layer's non-finite sentinel
+encoding, so ``jq``/``JSON.parse`` read them even for divergent runs):
+
+- ``POST /v1/submit``  — body: an ExperimentConfig field object (or
+  ``{"config": {...}}``). 202 → ``{"id", "status", "queue_depth"}``.
+  Malformed JSON / unknown fields / invalid configs → 400 with
+  ``{"error", "detail"}`` carrying the config validation message; the
+  request never enters the queue and in-flight work is untouched.
+- ``POST /v1/run``     — submit AND wait; streams the finished request's
+  RunTrace manifest back as one JSONL line (the curl one-liner in
+  docs/SERVING.md). ``?timeout=S`` bounds the wait (default 300).
+- ``GET /v1/result/<id>[?timeout=S]`` — the manifest once done (200), a
+  status object while queued/running (202), 404 for unknown ids, 500
+  body with the failure message for failed requests.
+- ``GET /v1/status``   — service stats: queue depth, cohort/coalescing
+  counters, executable-cache hits/misses/compile-seconds-saved.
+- ``POST /v1/shutdown`` — drain nothing, stop accepting, exit cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from distributed_optimization_tpu.log import get_logger
+from distributed_optimization_tpu.serving.service import (
+    DONE,
+    FAILED,
+    QueueFullError,
+    ServingError,
+    ServingOptions,
+    SimulationService,
+)
+
+_log = get_logger("serving.daemon")
+
+DEFAULT_PORT = 8421
+DEFAULT_RUN_TIMEOUT_S = 300.0
+MAX_BODY_BYTES = 1_000_000  # a config object is ~1 KB; bound hostile bodies
+
+
+def _strict_json(obj) -> bytes:
+    from distributed_optimization_tpu.telemetry import _encode_nonfinite
+
+    return (
+        json.dumps(_encode_nonfinite(obj), sort_keys=True, allow_nan=False)
+        + "\n"
+    ).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # The service lives on the server object (one per daemon).
+    server: "_Server"
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route http.server chatter to our log
+        _log.debug("%s " + fmt, self.address_string(), *args)
+
+    # ------------------------------------------------------------- helpers
+    def _send(self, code: int, payload: dict, *, jsonl: bool = False) -> None:
+        body = _strict_json(payload)
+        self.send_response(code)
+        self.send_header(
+            "Content-Type",
+            "application/x-ndjson" if jsonl else "application/json",
+        )
+        self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            # A route decided the connection cannot be reused (e.g. an
+            # oversized body it refused to read); say so on the wire.
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, error: str, detail: str = "") -> None:
+        self._send(code, {"error": error, "detail": detail})
+
+    def _read_config(self) -> Optional[dict]:
+        """Parse the request body into a config dict, or answer 400 and
+        return None. Structured errors, never a dead connection."""
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            length = 0
+        if length <= 0:
+            self._error(400, "empty_body",
+                        "POST a JSON ExperimentConfig object")
+            return None
+        if length > MAX_BODY_BYTES:
+            # Refusing to READ the oversized body would desync a
+            # keep-alive connection (the unread bytes would parse as the
+            # next request line), so this rejection also closes it.
+            self.close_connection = True
+            self._error(400, "body_too_large",
+                        f"config bodies are capped at {MAX_BODY_BYTES} bytes")
+            return None
+        raw = self.rfile.read(length)
+        try:
+            payload = json.loads(raw)
+        except json.JSONDecodeError as e:
+            self._error(400, "malformed_json", str(e))
+            return None
+        if isinstance(payload, dict) and isinstance(
+            payload.get("config"), dict
+        ):
+            payload = payload["config"]
+        if not isinstance(payload, dict):
+            self._error(
+                400, "invalid_request",
+                "body must be a JSON object of ExperimentConfig fields "
+                "(optionally wrapped as {\"config\": {...}})",
+            )
+            return None
+        return payload
+
+    def _query(self) -> dict:
+        return parse_qs(urlparse(self.path).query)
+
+    def _timeout(self, default: float) -> float:
+        q = self._query().get("timeout")
+        try:
+            return float(q[0]) if q else default
+        except ValueError:
+            return default
+
+    def _respond_request(self, req) -> None:
+        if req.status == DONE:
+            self._send(200, req.manifest, jsonl=True)
+        elif req.status == FAILED:
+            self._send(500, {
+                **req.status_dict(),
+                "error": "run_failed",
+                "detail": req.error,
+            })
+        else:
+            self._send(202, {
+                **req.status_dict(),
+                "queue_depth": self.server.service.queue_depth(),
+            })
+
+    # ------------------------------------------------------------- routes
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        path = urlparse(self.path).path.rstrip("/")
+        service = self.server.service
+        if path == "/v1/shutdown":
+            self._send(200, {"status": "shutting_down"})
+            self.server.initiate_shutdown()
+            return
+        if path not in ("/v1/submit", "/v1/run"):
+            self._error(404, "unknown_endpoint", path)
+            return
+        payload = self._read_config()
+        if payload is None:
+            return
+        try:
+            request_id = service.submit(payload)
+        except QueueFullError as e:
+            # Backpressure is retryable server state, not a bad request —
+            # a distinct status so clients can implement retry without
+            # string-matching the detail.
+            self._error(429, "queue_full", str(e))
+            return
+        except ServingError as e:
+            # The structured rejection (config validation message included)
+            # — a poison submission answers 400 and touches nothing else.
+            self._error(400, "invalid_config", str(e))
+            return
+        if path == "/v1/submit":
+            self._send(202, {
+                "id": request_id,
+                "status": "queued",
+                "queue_depth": service.queue_depth(),
+            })
+            return
+        try:
+            req = service.result(
+                request_id, timeout=self._timeout(DEFAULT_RUN_TIMEOUT_S)
+            )
+        except TimeoutError as e:
+            self._error(504, "timeout", str(e))
+            return
+        self._respond_request(req)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        path = urlparse(self.path).path.rstrip("/")
+        service = self.server.service
+        if path == "/v1/status":
+            self._send(200, {"status": "serving", **service.stats()})
+            return
+        if path.startswith("/v1/result/"):
+            request_id = path[len("/v1/result/"):]
+            try:
+                req = service.get(request_id)
+            except KeyError:
+                self._error(404, "unknown_request", request_id)
+                return
+            timeout = self._timeout(0.0)
+            if timeout > 0:
+                req.done.wait(timeout)
+            self._respond_request(req)
+            return
+        self._error(404, "unknown_endpoint", path)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # Serving requests block for seconds; keep the accept queue generous.
+    request_queue_size = 32
+
+    def __init__(self, addr, service: SimulationService):
+        super().__init__(addr, _Handler)
+        self.service = service
+
+    def initiate_shutdown(self) -> None:
+        # shutdown() must not run on a handler thread (it joins the serve
+        # loop); hand it to a one-shot thread.
+        threading.Thread(target=self.shutdown, daemon=True).start()
+
+
+class ServingDaemon:
+    """The HTTP daemon: owns a ``SimulationService`` (scheduler started)
+    and a threading HTTP server. ``serve_forever()`` blocks (the CLI
+    mode); ``start()``/``stop()`` run it on a background thread (tests,
+    ``make serve-smoke``)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = DEFAULT_PORT,
+        options: Optional[ServingOptions] = None,
+        *,
+        service: Optional[SimulationService] = None,
+    ):
+        self.service = service or SimulationService(options)
+        self._server = _Server((host, port), self.service)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> tuple:
+        return self._server.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def serve_forever(self) -> None:
+        self.service.start()
+        host, port = self.address
+        _log.info("simulation service listening on http://%s:%s", host, port)
+        try:
+            self._server.serve_forever(poll_interval=0.2)
+        finally:
+            self.close()
+
+    def start(self) -> None:
+        self.service.start()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="serving-daemon", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.close()
+
+    def close(self) -> None:
+        self.service.close()
+        self._server.server_close()
+
+
+def main(argv=None) -> int:
+    """``python -m distributed_optimization_tpu.serve`` entry point."""
+    import argparse
+
+    from distributed_optimization_tpu.log import configure as configure_logging
+
+    p = argparse.ArgumentParser(
+        prog="distributed_optimization_tpu.serve",
+        description=(
+            "Simulation-as-a-service daemon: POST ExperimentConfig JSON, "
+            "stream RunTrace manifests back; structurally identical "
+            "concurrent requests coalesce into one batched XLA program and "
+            "repeat programs reuse cached executables (docs/SERVING.md)."
+        ),
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT,
+                   help=f"TCP port (default {DEFAULT_PORT}; 0 = ephemeral)")
+    p.add_argument("--window-ms", type=float, default=50.0,
+                   help="coalescing wait window after work arrives "
+                        "(latency traded for batching opportunity)")
+    p.add_argument("--max-cohort", type=int, default=32,
+                   help="replica-axis cap per coalesced run_batch call")
+    p.add_argument("--max-pending", type=int, default=1024,
+                   help="queue bound; submits beyond it get a 400")
+    p.add_argument("--platform", choices=("tpu", "cpu", "auto"),
+                   default="auto",
+                   help="force the JAX platform before first use")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("-q", "--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    configure_logging(1 if args.verbose else (-1 if args.quiet else 0))
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    daemon = ServingDaemon(
+        args.host, args.port,
+        ServingOptions(
+            window_s=args.window_ms / 1000.0,
+            max_cohort=args.max_cohort,
+            max_pending=args.max_pending,
+        ),
+    )
+    try:
+        daemon.serve_forever()
+    except KeyboardInterrupt:
+        daemon.close()
+    return 0

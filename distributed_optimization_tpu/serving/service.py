@@ -1,0 +1,502 @@
+"""The simulation service: submit configs, get RunTrace manifests back.
+
+``SimulationService`` is the Python front end of the serving subsystem
+(docs/SERVING.md) — the daemon (``serving/daemon.py``) is a thin HTTP shim
+over it:
+
+- ``submit(config)`` validates the request (strict field check + the
+  frozen config's own cross-field validation; malformed requests raise
+  ``ServingError`` with the validation message, they never enter the
+  queue) and enqueues it. The queue is bounded (``max_pending``), and so
+  is the finished-request history (``max_done`` — a long-lived daemon
+  rotates out old results instead of retaining every payload forever).
+- a scheduler loop (``start()`` / the daemon) or an explicit ``drain()``
+  coalesces pending requests within a wait window into ``run_batch``
+  cohorts (``serving/coalescer.py``), executes each cohort through the
+  process executable cache, and resolves every request to its own
+  per-replica slice.
+- each finished request carries its ``BackendRunResult`` AND a
+  schema-versioned ``RunTrace`` manifest whose health block records the
+  serving facts (cache hit, compile seconds saved, cohort size, queue
+  wait) — the JSONL the daemon streams back.
+
+Failure isolation: an exception while executing one plan (e.g. a config
+that passes field validation but is rejected by the backend, like a robust
+budget exceeding the topology's min degree) fails THAT plan's requests
+with a structured error and leaves the queue, other cohorts, and the
+scheduler loop alive — tests/test_serving.py submits exactly such a poison
+request next to a healthy cohort.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.log import get_logger
+from distributed_optimization_tpu.serving.cache import (
+    ExecutableCache,
+    process_executable_cache,
+)
+from distributed_optimization_tpu.serving.coalescer import (
+    REPLICAS_UNSUPPORTED_REASON,
+    execute_plan,
+    plan_cohorts,
+)
+
+_log = get_logger("serving")
+
+_CONFIG_FIELDS = frozenset(
+    f.name for f in dataclasses.fields(ExperimentConfig)
+)
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+
+class ServingError(ValueError):
+    """A rejected request — malformed JSON shape, unknown fields, or a
+    config the validation layer refuses. The daemon maps it to a
+    structured 400 response; it never kills in-flight work."""
+
+
+class QueueFullError(ServingError):
+    """Backpressure, not a bad request: the bounded queue is full and the
+    submission should be RETRIED after in-flight work drains. The daemon
+    maps it to 429 so clients can tell it apart from a permanently
+    invalid config."""
+
+
+@dataclasses.dataclass
+class ServingOptions:
+    """Scheduler knobs (the daemon exposes them as flags).
+
+    ``window_s``: how long the scheduler waits after work arrives before
+    cutting cohorts — the latency it trades for coalescing opportunity.
+    ``max_cohort``: replica-axis cap per ``run_batch`` call. ``max_pending``
+    bounds the queue (submits beyond it are rejected, not buffered without
+    limit); in-flight work is additionally bounded by the scheduler being
+    single-threaded — one cohort executes at a time on the one chip.
+    ``max_done`` bounds the FINISHED-request history: a long-lived daemon
+    must not retain every served result forever, so once more than
+    ``max_done`` requests have completed, the oldest finished records (and
+    their result payloads/manifests) are dropped — a later result poll for
+    an evicted id gets "unknown request", the serving analogue of a log
+    rotation. Pending/running requests are never evicted.
+    """
+
+    window_s: float = 0.05
+    max_cohort: int = 32
+    max_pending: int = 1024
+    max_done: int = 512
+
+    def __post_init__(self) -> None:
+        if self.window_s < 0:
+            raise ValueError(f"window_s must be >= 0, got {self.window_s}")
+        if self.max_cohort < 1:
+            raise ValueError(
+                f"max_cohort must be >= 1, got {self.max_cohort}"
+            )
+        if self.max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1, got {self.max_pending}"
+            )
+        if self.max_done < 1:
+            raise ValueError(
+                f"max_done must be >= 1, got {self.max_done}"
+            )
+
+
+@dataclasses.dataclass
+class Request:
+    """One submitted simulation request and its lifecycle record."""
+
+    id: str
+    config: ExperimentConfig
+    submitted_at: float
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False
+    )
+    status: str = QUEUED
+    result: Any = None  # BackendRunResult when DONE
+    manifest: Optional[dict] = None  # RunTrace dict when DONE
+    error: Optional[str] = None  # message when FAILED
+    cohort_size: int = 0
+    coalesced: bool = False
+    sequential_reason: Optional[str] = None
+    cache_hit: Optional[bool] = None
+    queue_wait_s: Optional[float] = None
+    run_wall_s: Optional[float] = None
+
+    def status_dict(self) -> dict:
+        """The JSON-safe view the daemon returns for status polls."""
+        out = {
+            "id": self.id,
+            "status": self.status,
+            "config_hash": self.config.structural_hash(),
+        }
+        if self.error is not None:
+            out["error"] = self.error
+        if self.status in (DONE, FAILED):
+            out["serving"] = self.serving_block()
+        return out
+
+    def serving_block(self) -> dict:
+        """The per-request serving facts recorded into the manifest's
+        health block (telemetry satellite)."""
+        return {
+            "cache_hit": self.cache_hit,
+            "cohort_size": self.cohort_size,
+            "coalesced": self.coalesced,
+            "sequential_reason": self.sequential_reason,
+            "queue_wait_s": self.queue_wait_s,
+            "run_wall_s": self.run_wall_s,
+        }
+
+
+def parse_config(payload) -> ExperimentConfig:
+    """Strict config parsing for the serving surface.
+
+    Unlike ``ExperimentConfig.from_dict`` (which silently drops unknown
+    keys — fine for reading old manifests, wrong for a request API where a
+    typoed field would silently run the default), unknown keys are
+    rejected, and every validation error surfaces with the config's own
+    message.
+    """
+    if isinstance(payload, ExperimentConfig):
+        return payload
+    if not isinstance(payload, dict):
+        raise ServingError(
+            f"config must be a JSON object of ExperimentConfig fields, "
+            f"got {type(payload).__name__}"
+        )
+    unknown = set(payload) - _CONFIG_FIELDS
+    if unknown:
+        raise ServingError(
+            f"unknown config fields {sorted(unknown)}; valid fields are "
+            f"the ExperimentConfig schema (docs/SERVING.md)"
+        )
+    try:
+        return ExperimentConfig(**payload)
+    except (TypeError, ValueError) as e:
+        raise ServingError(f"invalid config: {e}") from e
+
+
+class SimulationService:
+    """Request-driven simulation with an executable cache and a request
+    coalescer (see the module docstring)."""
+
+    def __init__(
+        self,
+        options: Optional[ServingOptions] = None,
+        *,
+        cache: Optional[ExecutableCache] = None,
+        max_datasets: int = 16,
+    ):
+        self.options = options or ServingOptions()
+        # The service's compile amortization rides the process cache by
+        # default so CLI/Simulator warm-up carries over; pass an explicit
+        # instance to scope it (tests do). When the operator disabled the
+        # process cache (DOPT_EXEC_CACHE=0) and no explicit cache was
+        # given, the service honors the kill switch: it runs fully
+        # uncached (``self.cache is None`` → ``executable_cache=False``
+        # downstream) instead of silently substituting a private cache.
+        self.cache = (
+            cache if cache is not None else process_executable_cache()
+        )
+        self._max_datasets = max_datasets
+        self._datasets: dict[tuple, tuple] = {}  # key -> (ds, f_opt)
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pending: list[Request] = []
+        self._requests: dict[str, Request] = {}
+        # Finished-request ids in completion order — the bounded history
+        # (ServingOptions.max_done) a long-lived daemon rotates through.
+        self._done_order: "deque[str]" = deque()
+        self._counter = 0
+        # Coalescing/queue statistics (telemetry satellite). Bounded like
+        # every other long-lived buffer here: stats() reports over the
+        # most recent window, counters cover the lifetime.
+        self.cohort_sizes: "deque[int]" = deque(maxlen=4096)
+        self.queue_waits: "deque[float]" = deque(maxlen=4096)
+        self.n_done = 0
+        self.n_failed = 0
+        self.n_sequential = 0
+        self.n_cohorts = 0
+        self.data_gen_seconds = 0.0
+        self.oracle_seconds = 0.0
+
+    # ---------------------------------------------------------- submission
+    def submit(self, config) -> str:
+        """Validate and enqueue one request; returns its id.
+
+        Raises ``ServingError`` for malformed/invalid configs and when the
+        queue is full — rejected requests never enter the queue.
+        """
+        cfg = parse_config(config)
+        if cfg.replicas > 1:
+            raise ServingError(REPLICAS_UNSUPPORTED_REASON)
+        with self._lock:
+            if len(self._pending) >= self.options.max_pending:
+                raise QueueFullError(
+                    f"queue full ({self.options.max_pending} pending); "
+                    "retry after in-flight work drains"
+                )
+            self._counter += 1
+            req = Request(
+                id=f"req-{self._counter:06d}",
+                config=cfg,
+                submitted_at=time.perf_counter(),
+            )
+            self._pending.append(req)
+            self._requests[req.id] = req
+        self._wake.set()
+        return req.id
+
+    # ------------------------------------------------------------- lookup
+    def get(self, request_id: str) -> Request:
+        with self._lock:
+            req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(f"unknown request id {request_id!r}")
+        return req
+
+    def result(self, request_id: str, timeout: Optional[float] = None):
+        """Block until the request finishes; returns the Request record
+        (status DONE or FAILED), or raises TimeoutError."""
+        req = self.get(request_id)
+        if not req.done.wait(timeout):
+            raise TimeoutError(
+                f"request {request_id} still {req.status} after {timeout}s"
+            )
+        return req
+
+    # ---------------------------------------------------------- scheduling
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def process_once(self) -> int:
+        """Cut cohorts from everything currently pending and execute them;
+        returns the number of requests resolved. The scheduler loop calls
+        this after the wait window; tests call it directly for determinism.
+        """
+        with self._lock:
+            batch, self._pending = self._pending, []
+        if not batch:
+            return 0
+        plans = plan_cohorts(batch, self.options.max_cohort)
+        n = 0
+        for plan in plans:
+            self._execute(plan)
+            n += plan.size
+        return n
+
+    def drain(self) -> int:
+        """Process until the queue is empty (synchronous callers/tests)."""
+        total = 0
+        while self.queue_depth() > 0:
+            total += self.process_once()
+        return total
+
+    def _dataset_for(self, cfg: ExperimentConfig):
+        """Dataset + reference optimum for a request, memoized on the
+        fields that determine them (bounded FIFO — datasets are cheap to
+        regenerate, the memo just keeps cohort cuts snappy)."""
+        from distributed_optimization_tpu.utils.data import (
+            generate_synthetic_dataset,
+        )
+        from distributed_optimization_tpu.utils.oracle import (
+            compute_reference_optimum,
+        )
+
+        key = (
+            cfg.problem_type, cfg.n_samples, cfg.n_features,
+            cfg.n_informative_features, cfg.classification_sep,
+            cfg.n_classes, cfg.partition, cfg.n_workers,
+            cfg.resolved_data_seed(), cfg.reg_param, cfg.huber_delta,
+        )
+        with self._lock:
+            hit = self._datasets.get(key)
+        if hit is not None:
+            return hit
+        t0 = time.perf_counter()
+        ds = generate_synthetic_dataset(cfg)
+        t1 = time.perf_counter()
+        _, f_opt = compute_reference_optimum(
+            ds, cfg.reg_param, huber_delta=cfg.huber_delta,
+            n_classes=cfg.n_classes,
+        )
+        t2 = time.perf_counter()
+        with self._lock:
+            self.data_gen_seconds += t1 - t0
+            self.oracle_seconds += t2 - t1
+            while len(self._datasets) >= self._max_datasets:
+                self._datasets.pop(next(iter(self._datasets)))
+            self._datasets[key] = (ds, float(f_opt))
+        return ds, float(f_opt)
+
+    def _execute(self, plan) -> None:
+        t_start = time.perf_counter()
+        for req in plan.requests:
+            req.status = RUNNING
+            req.queue_wait_s = t_start - req.submitted_at
+            req.cohort_size = plan.size
+            req.coalesced = plan.coalesced
+            req.sequential_reason = plan.sequential_reason
+        try:
+            ds, f_opt = self._dataset_for(plan.base)
+            results = execute_plan(
+                plan, ds, f_opt,
+                # Honor the kill switch: no cache means COLD compiles, not
+                # a silently substituted private cache.
+                executable_cache=(
+                    self.cache if self.cache is not None else False
+                ),
+            )
+            wall = time.perf_counter() - t_start
+        except Exception as e:  # isolate the poison plan, keep serving
+            msg = f"{type(e).__name__}: {e}"
+            _log.warning("plan of %d request(s) failed: %s", plan.size, msg)
+            with self._lock:
+                self.n_failed += plan.size
+            for req in plan.requests:
+                req.status = FAILED
+                req.error = msg
+                self._finish(req)
+            return
+        with self._lock:
+            self.n_cohorts += 1
+            self.cohort_sizes.append(plan.size)
+            self.queue_waits.extend(
+                r.queue_wait_s for r in plan.requests
+            )
+            self.n_done += plan.size
+            if plan.sequential_reason is not None:
+                self.n_sequential += plan.size
+        jax_cached_path = (
+            plan.base.backend == "jax" and plan.base.tp_degree == 1
+            and self.cache is not None
+        )
+        for req, res in zip(plan.requests, results):
+            req.result = res
+            # Race-free per-request cache fact: the service always
+            # measures compile, so zero compile seconds on a cached jax
+            # path means this request's executable came from the cache —
+            # no shared-counter delta that concurrent cache users could
+            # skew. None when caching is off or the path has no reusable
+            # jax compile (numpy/cpp/TP).
+            req.cache_hit = (
+                res.history.compile_seconds == 0.0
+                if jax_cached_path else None
+            )
+            req.run_wall_s = wall
+            req.manifest = self._manifest(req, res)
+            req.status = DONE
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        """Mark a request finished and rotate the bounded history: beyond
+        ``max_done`` completed records, the oldest finished request (and
+        its result payload) is dropped — later polls for its id get
+        "unknown request". Pending/running requests are never evicted."""
+        req.done.set()
+        with self._lock:
+            self._done_order.append(req.id)
+            while len(self._done_order) > self.options.max_done:
+                self._requests.pop(self._done_order.popleft(), None)
+
+    def _manifest(self, req: Request, res) -> dict:
+        """The request's RunTrace manifest (the daemon's response body):
+        config + hash, phases, trace buffers when the request asked for
+        telemetry, and the health block extended with the serving facts."""
+        from distributed_optimization_tpu import telemetry
+
+        health = telemetry.health_summary(
+            req.config, res.history, serving=req.serving_block(),
+        )
+        return telemetry.build_run_trace(
+            req.id, req.config, res.history,
+            phases={
+                "queue_wait": req.queue_wait_s or 0.0,
+                "run": req.run_wall_s or 0.0,
+            },
+            health=health,
+        ).to_dict()
+
+    # ----------------------------------------------------- background loop
+    def start(self) -> None:
+        """Start the scheduler thread (the daemon's mode). Idempotent."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="simulation-service", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._wake.wait(timeout=0.2):
+                continue
+            # The coalescing window: give concurrent submitters a beat to
+            # land in the same cut before cohorts are formed.
+            if self.options.window_s > 0:
+                time.sleep(self.options.window_s)
+            self._wake.clear()
+            try:
+                self.process_once()
+            except Exception:  # pragma: no cover - belt and braces
+                _log.exception("scheduler iteration failed; continuing")
+
+    def close(self) -> None:
+        """Stop the scheduler loop (pending work stays queued)."""
+        self._stop.set()
+        self._wake.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------------ telemetry
+    def stats(self) -> dict:
+        """Service-level counters: queue, cohorts, cache (JSON-safe)."""
+        import numpy as np
+
+        with self._lock:
+            sizes = list(self.cohort_sizes)
+            waits = list(self.queue_waits)
+            out = {
+                "queue_depth": len(self._pending),
+                "requests_total": self._counter,
+                "requests_done": self.n_done,
+                "requests_failed": self.n_failed,
+                "requests_sequential_fallback": self.n_sequential,
+                # count is lifetime; mean/max summarize the most recent
+                # window (the deques are bounded — see __init__).
+                "cohorts": {
+                    "count": self.n_cohorts,
+                    "mean_size": float(np.mean(sizes)) if sizes else None,
+                    "max_size": int(max(sizes)) if sizes else None,
+                },
+                "queue_wait_s": {
+                    "mean": float(np.mean(waits)) if waits else None,
+                    "max": float(max(waits)) if waits else None,
+                },
+                "data_gen_seconds": self.data_gen_seconds,
+                "oracle_seconds": self.oracle_seconds,
+                "cache": (
+                    self.cache.stats() if self.cache is not None
+                    else {"disabled": True}
+                ),
+            }
+        return out

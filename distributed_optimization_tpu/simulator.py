@@ -265,10 +265,21 @@ class Simulator:
         it stays a print, unlike the progress logging above.
         """
         from distributed_optimization_tpu.reporting import format_report
+        from distributed_optimization_tpu.serving.cache import (
+            process_executable_cache,
+        )
 
+        # One-line serving summary (docs/SERVING.md): the process-wide
+        # executable cache amortizes AOT compiles across run_one calls in
+        # this process; surfaced once it has actually saved a compile.
+        cache = process_executable_cache()
+        serving = (
+            cache.stats() if cache is not None and cache.hits > 0 else None
+        )
         text = format_report(
             self.records, self.config, self.f_opt,
             phases=dict(self.phase_timer.phases),
+            serving=serving,
         )
         print(text)
         return text

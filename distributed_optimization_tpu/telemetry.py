@@ -334,7 +334,7 @@ def realized_bhat(config, max_cells: int = 2_000_000) -> Optional[dict]:
             "horizon": horizon}
 
 
-def health_summary(config, history) -> dict:
+def health_summary(config, history, *, serving: Optional[dict] = None) -> dict:
     """Derive the run-health block from a finished run's history.
 
     Always includes the final gap, the realized/nominal connectivity
@@ -342,8 +342,16 @@ def health_summary(config, history) -> dict:
     production currency compressed gossip trades on); trace-derived
     statistics (worst-worker grad norm, non-finite totals, liveness)
     appear when the run recorded trace buffers.
+
+    ``serving``: the per-request serving facts (executable-cache hit,
+    compile seconds saved, cohort size/coalescing, queue wait — see
+    ``serving.service.Request.serving_block``) recorded verbatim under
+    ``"serving"`` when the run was served rather than invoked directly;
+    ``format_report`` summarizes them in its one-line serving section.
     """
     h: dict[str, Any] = {}
+    if serving is not None:
+        h["serving"] = dict(serving)
     obj = np.asarray(history.objective, dtype=np.float64)
     finite = obj[np.isfinite(obj)]
     h["final_gap"] = float(obj[-1]) if obj.size else None

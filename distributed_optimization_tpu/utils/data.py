@@ -70,7 +70,9 @@ def generate_synthetic_dataset(config) -> HostDataset:
     Mirrors reference ``utils.py:5-50``: same sklearn generators, same
     hyperparameters (n_redundant = n_features - n_informative,
     n_clusters_per_class=1, flip_y=0.05, random_state=203 by default via
-    ``config.seed``; noise=10.0 for regression), labels mapped to ±1,
+    ``config.resolved_data_seed()`` — ``seed`` unless ``data_seed`` pins the
+    problem instance independently; noise=10.0 for regression), labels
+    mapped to ±1,
     StandardScaler, bias column, argsort(y) + array_split partition.
     """
     from sklearn.datasets import make_classification, make_regression
@@ -85,7 +87,7 @@ def generate_synthetic_dataset(config) -> HostDataset:
             n_clusters_per_class=1,
             flip_y=0.05,
             class_sep=config.classification_sep,
-            random_state=config.seed,
+            random_state=config.resolved_data_seed(),
         )
         y = y.astype(np.float64) * 2.0 - 1.0
     elif config.problem_type == "softmax":
@@ -110,7 +112,7 @@ def generate_synthetic_dataset(config) -> HostDataset:
             n_clusters_per_class=1,
             flip_y=0.05,
             class_sep=config.classification_sep,
-            random_state=config.seed,
+            random_state=config.resolved_data_seed(),
         )
         y = y.astype(np.float64)
     elif config.problem_type in ("quadratic", "huber"):
@@ -121,7 +123,7 @@ def generate_synthetic_dataset(config) -> HostDataset:
             n_features=config.n_features,
             n_informative=config.n_informative_features,
             noise=10.0,
-            random_state=config.seed,
+            random_state=config.resolved_data_seed(),
         )
         y = y.astype(np.float64)
     else:
@@ -137,7 +139,7 @@ def generate_synthetic_dataset(config) -> HostDataset:
     # robust-aggregation analyses assume (docs/BYZANTINE.md), and a control
     # for separating non-IID effects in any experiment.
     if config.partition == "shuffled":
-        order = np.random.default_rng(config.seed).permutation(y.shape[0])
+        order = np.random.default_rng(config.resolved_data_seed()).permutation(y.shape[0])
     else:
         order = np.argsort(y)
     shard_indices = [np.asarray(s) for s in np.array_split(order, config.n_workers)]
@@ -184,7 +186,7 @@ def generate_digits_dataset(config) -> HostDataset:
     X = np.hstack([X, np.ones((X.shape[0], 1))])
 
     if config.partition == "shuffled":
-        order = np.random.default_rng(config.seed).permutation(y.shape[0])
+        order = np.random.default_rng(config.resolved_data_seed()).permutation(y.shape[0])
     else:
         order = np.argsort(y, kind="stable")
     shard_indices = [np.asarray(s) for s in np.array_split(order, config.n_workers)]

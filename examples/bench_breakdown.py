@@ -49,10 +49,15 @@ BASE = dict(
 
 
 def measure(cfg, ds, f_opt, repeats=2, **kw):
+    # This bench's protocol records the PER-CALL compile cost (the
+    # scan_unroll section quotes it), so it opts out of the process
+    # executable cache — a repeat would otherwise hit the cache and
+    # record 0.0s compile (docs/SERVING.md; the cached regime is measured
+    # in docs/perf/serving.json).
     best = 0.0
     compile_s = 0.0
     for _ in range(repeats):
-        res = jax_backend.run(cfg, ds, f_opt, **kw)
+        res = jax_backend.run(cfg, ds, f_opt, executable_cache=False, **kw)
         best = max(best, float(res.history.iters_per_second))
         compile_s = float(res.history.compile_seconds)
     return best, compile_s
@@ -68,7 +73,9 @@ def measure_group(variants, ds, f_opt, cycles=3):
     best = {name: 0.0 for name in variants}
     for _ in range(cycles):
         for name, (cfg, kw) in variants.items():
-            res = jax_backend.run(cfg, ds, f_opt, **kw)
+            res = jax_backend.run(
+                cfg, ds, f_opt, executable_cache=False, **kw
+            )
             best[name] = max(best[name], float(res.history.iters_per_second))
     return best
 

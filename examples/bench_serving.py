@@ -1,0 +1,334 @@
+"""Serving-layer traffic bench (the ISSUE-7 tentpole evidence).
+
+Replays a synthetic mixed workload — repeat configs, sweep variants,
+structural outliers — through ``serving.SimulationService`` and measures
+the two amortizations the subsystem exists for:
+
+1. **Executable-cache latency** (``latency`` cell): submit→start latency
+   of a request whose structural class is already compiled (cache hit:
+   queue wait + executable lookup) vs a cold structural class (queue wait
+   + the whole-run XLA compile, docs/PERF.md §3). Submit→done wall times
+   are recorded alongside, ungated — they include the run itself, which
+   serving cannot amortize.
+2. **Coalescing throughput** (``throughput`` cell): R eta0-variant
+   requests submitted together (one ``run_batch`` cohort, one program
+   execution) vs the same R requests submitted one-at-a-time (R warm
+   program executions), both through the service with warm caches — the
+   pure coalescing gain, the serving twin of docs/perf/sweep.json's
+   replica-batching measurement.
+
+Asserted floors (bench.py convention, BENCH_NO_RANGE_CHECK escape):
+
+- warm cache-hit submit→start must be ≥ 10× lower than cold-compile
+  submit→start (hardware-independent: a dict lookup vs a multi-second
+  XLA compile);
+- coalesced requests/sec at cohort R ≥ 8 must be ≥ 2.5× one-at-a-time on
+  this CPU container (the SIMD-fill floor bench_sweep measured for the
+  replica axis; accelerator platforms inherit the sweep bench's ≥ 8×
+  expectation), with an honest ``coalescing_loses`` flag either way.
+
+The served-vs-standalone parity gate (bitwise/≤ 1e-12) runs in tier-1
+(tests/test_serving.py); this bench re-checks it on a small f64 cohort
+and records the realized max deviation.
+
+Writes ``docs/perf/serving.json`` (+ manifest sidecar).
+
+Usage:  python examples/bench_serving.py [--out PATH] [--cohort 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+FLOOR_WARM_VS_COLD = 10.0   # submit->start, cache hit vs cold compile
+FLOOR_COALESCED_CPU = 2.5   # requests/sec, cohort R>=8 vs one-at-a-time
+PARITY_TOL = 1e-12          # served vs standalone, f64
+
+
+def _mk_service(window_s=0.0, max_cohort=32):
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    return SimulationService(
+        ServingOptions(window_s=window_s, max_cohort=max_cohort),
+        cache=ExecutableCache(),
+    )
+
+
+def _submit_and_drain(svc, configs):
+    ids = [svc.submit(c) for c in configs]
+    svc.drain()
+    return [svc.result(i, timeout=600) for i in ids]
+
+
+def _start_latency(req) -> float:
+    """Submit→start: queue wait plus program acquisition (the compile on a
+    miss, the cache lookup on a hit). The run itself is excluded — serving
+    amortizes compiles, not gradient math."""
+    return float(req.queue_wait_s) + float(req.result.history.compile_seconds)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/perf/serving.json")
+    ap.add_argument("--cohort", type=int, default=16,
+                    help="coalesced-throughput cohort size (>= 8)")
+    args = ap.parse_args()
+    if args.cohort < 8:
+        raise SystemExit("--cohort must be >= 8 (the gated regime)")
+
+    import jax
+
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.utils.profiling import PhaseTimer
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    print(f"[serving] device={dev} platform={platform}", file=sys.stderr)
+    timer = PhaseTimer()
+
+    # The flagship decentralized shape (reference main.py defaults) at a
+    # bench-scale horizon — the same cell family bench_sweep measures, so
+    # the coalescing numbers compose with the replica-batching numbers.
+    base = ExperimentConfig(
+        problem_type="logistic", algorithm="dsgd", topology="ring",
+        n_iterations=500, eval_every=100,
+    )
+
+    # ---- 1. latency: cold compile vs warm cache hit -------------------
+    with timer.phase("latency"):
+        svc = _mk_service()
+        cold = _submit_and_drain(svc, [base])[0]
+        warm = _submit_and_drain(svc, [base])[0]
+        # A sweep VARIANT of the warm class also hits (the structural-hash
+        # contract) — recorded to show reuse is class-wide, not repeat-only.
+        variant = _submit_and_drain(
+            svc, [base.replace(learning_rate_eta0=0.11)]
+        )[0]
+    assert cold.cache_hit is False and warm.cache_hit is True
+    assert variant.cache_hit is True
+    cold_start = _start_latency(cold)
+    warm_start = _start_latency(warm)
+    latency = {
+        "cold_submit_to_start_s": round(cold_start, 4),
+        "warm_hit_submit_to_start_s": round(warm_start, 4),
+        "variant_hit_submit_to_start_s": round(_start_latency(variant), 4),
+        "cold_submit_to_done_s": round(
+            cold.queue_wait_s + cold.run_wall_s, 4
+        ),
+        "warm_submit_to_done_s": round(
+            warm.queue_wait_s + warm.run_wall_s, 4
+        ),
+        "cold_compile_s": round(cold.result.history.compile_seconds, 4),
+        "speedup_submit_to_start": round(cold_start / warm_start, 1),
+    }
+    print(
+        f"[serving] latency: cold start {cold_start:.3f}s vs warm "
+        f"{warm_start * 1e3:.1f}ms ({latency['speedup_submit_to_start']}x)",
+        file=sys.stderr,
+    )
+
+    # ---- 2. throughput: coalesced cohort vs one-at-a-time -------------
+    R = args.cohort
+    etas = [0.02 + 0.01 * i for i in range(R)]
+    variants = [base.replace(learning_rate_eta0=e) for e in etas]
+    with timer.phase("throughput"):
+        svc = _mk_service()
+        # Warm both program shapes out of the measured window: the R=1
+        # program (one-at-a-time path) and the R-cohort program.
+        _submit_and_drain(svc, [base])
+        _submit_and_drain(svc, variants)
+
+        t0 = time.perf_counter()
+        for cfg in variants:
+            _submit_and_drain(svc, [cfg])  # submit, wait, submit, ...
+        seq_wall = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        reqs = _submit_and_drain(svc, variants)  # one coalesced cut
+        coal_wall = time.perf_counter() - t0
+    assert all(r.cohort_size == R for r in reqs), "cohort did not coalesce"
+    assert all(r.cache_hit for r in reqs), "throughput cells must be warm"
+    seq_rps = R / seq_wall
+    coal_rps = R / coal_wall
+    throughput = {
+        "cohort_R": R,
+        "sequential_requests_per_s": round(seq_rps, 2),
+        "coalesced_requests_per_s": round(coal_rps, 2),
+        "sequential_wall_s": round(seq_wall, 2),
+        "coalesced_wall_s": round(coal_wall, 2),
+        "speedup": round(coal_rps / seq_rps, 2),
+        "coalescing_loses": coal_rps < seq_rps,
+    }
+    print(
+        f"[serving] throughput R={R}: {coal_rps:.2f} coalesced vs "
+        f"{seq_rps:.2f} sequential req/s ({throughput['speedup']}x)",
+        file=sys.stderr,
+    )
+
+    # ---- 3. mixed-workload replay (stats snapshot, ungated) -----------
+    with timer.phase("workload"):
+        svc = _mk_service()
+        stream = (
+            [base] * 4                                         # repeats
+            + [base.replace(learning_rate_eta0=e)
+               for e in (0.03, 0.07, 0.09, 0.13)]              # sweep variants
+            + [base.replace(seed=base.seed + i) for i in (1, 2)]  # seed variants
+            + [base.replace(topology="fully_connected"),
+               base.replace(eval_every=50)]                    # outliers
+        )
+        t0 = time.perf_counter()
+        _submit_and_drain(svc, stream)
+        stream_wall = time.perf_counter() - t0
+        st = svc.stats()
+    workload = {
+        "requests": len(stream),
+        "wall_s": round(stream_wall, 2),
+        "requests_per_s": round(len(stream) / stream_wall, 2),
+        "cohorts": st["cohorts"],
+        "cache": {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in st["cache"].items()
+        },
+        "composition": "4 repeats + 4 eta0 variants + 2 seed variants "
+                       "+ 2 structural outliers",
+    }
+    print(
+        f"[serving] workload: {len(stream)} requests, "
+        f"{st['cache']['misses']} compiles, "
+        f"{st['cache']['hits']} cache hits, "
+        f"{st['cohorts']['count']} cohorts",
+        file=sys.stderr,
+    )
+
+    # ---- 4. parity re-check (f64; the tier-1 gate's convention) -------
+    from distributed_optimization_tpu.backends import jax_backend
+
+    with timer.phase("parity"):
+        svc = _mk_service()
+        pcfg = base.replace(
+            dtype="float64", n_iterations=200, eval_every=50,
+        )
+        pvariants = [pcfg.replace(learning_rate_eta0=e)
+                     for e in (0.05, 0.09, 0.05)]
+        preqs = _submit_and_drain(svc, pvariants)
+        ds, f_opt = svc._dataset_for(pcfg)
+        max_dev = 0.0
+        for req in preqs:
+            seq = jax_backend.run(
+                req.config, ds, f_opt, executable_cache=False
+            )
+            max_dev = max(
+                max_dev,
+                float(np.max(np.abs(
+                    req.result.history.objective - seq.history.objective
+                ))),
+                float(np.max(np.abs(
+                    req.result.final_models - seq.final_models
+                ))),
+            )
+    assert preqs[0].cohort_size == len(pvariants)
+    assert max_dev <= PARITY_TOL, (
+        f"served-vs-standalone deviation {max_dev} exceeds {PARITY_TOL}"
+    )
+    parity = {
+        "cohort_R": len(pvariants),
+        "max_abs_deviation_f64": max_dev,
+        "tol": PARITY_TOL,
+        "tier1_gate": "tests/test_serving.py::"
+                      "test_served_cohort_matches_standalone_run",
+    }
+    print(f"[serving] parity: max dev {max_dev:.2e} (f64)", file=sys.stderr)
+
+    # ---- asserted floors (BENCH_NO_RANGE_CHECK escape hatch) ----------
+    skip = os.environ.get("BENCH_NO_RANGE_CHECK", "").lower() not in (
+        "", "0", "false"
+    )
+    ratio = cold_start / warm_start
+    if skip:
+        print(
+            "[serving] BENCH_NO_RANGE_CHECK set: skipping the floor gates "
+            "(non-canonical hardware mode)",
+            file=sys.stderr,
+        )
+    else:
+        assert ratio >= FLOOR_WARM_VS_COLD, (
+            f"warm cache-hit submit->start is only {ratio:.1f}x below "
+            f"cold compile (floor {FLOOR_WARM_VS_COLD}x) — the executable "
+            "cache is not amortizing the compile; investigate before "
+            "publishing"
+        )
+        assert throughput["speedup"] >= FLOOR_COALESCED_CPU, (
+            f"coalesced throughput {throughput['speedup']}x is below the "
+            f"{FLOOR_COALESCED_CPU}x floor at R={R} — request coalescing "
+            "is not paying for itself; investigate before publishing"
+        )
+    gates = {
+        "warm_vs_cold_submit_to_start_floor": FLOOR_WARM_VS_COLD,
+        "coalesced_throughput_floor_cpu_r8plus": FLOOR_COALESCED_CPU,
+        "applied": not skip,
+        "measured_warm_vs_cold": round(ratio, 1),
+        "measured_coalesced_speedup": throughput["speedup"],
+        "parity_max_abs_deviation_f64": max_dev,
+    }
+
+    payload = {
+        "device": str(dev),
+        "platform": platform,
+        "protocol": (
+            "SimulationService over the flagship N=25 ring logistic cell "
+            "(T=500). latency: submit->start = queue wait + program "
+            "acquisition (cold = XLA compile, warm = executable-cache "
+            "lookup; the run itself is excluded and reported separately "
+            "as submit->done). throughput: R eta0-variant requests as one "
+            "coalesced run_batch cohort vs the same R submitted "
+            "one-at-a-time, both warm (pure coalescing gain; the replica "
+            "axis's SIMD-fill regime measured in docs/perf/sweep.json). "
+            "workload: a mixed stream (repeats/sweeps/seed variants/"
+            "structural outliers) with the service's own cohort+cache "
+            "counters. parity: served-vs-standalone max |dev| in f64, "
+            "asserted <= 1e-12 here and gated in tier-1."
+        ),
+        "note": (
+            "Floors are regime-honest: the 10x latency floor is hardware-"
+            "independent (dict lookup vs multi-second compile); the 2.5x "
+            "throughput floor is this single-core CPU container's "
+            "SIMD-fill regime (bench_sweep's measured 3.5-4.6x at R=32 "
+            "bounds what coalescing can recover here) — on accelerators "
+            "the replica axis's >= 8x regime applies and coalescing "
+            "inherits it. coalescing_loses flags any measured inversion."
+        ),
+        "workload": workload,
+        "latency": latency,
+        "throughput": throughput,
+        "parity": parity,
+        "gates": gates,
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+
+    write_bench_manifest(path, config=base, phases=timer)
+
+    print(json.dumps({
+        "metric": "serving_warm_vs_cold_and_coalesced_speedup",
+        "warm_vs_cold": gates["measured_warm_vs_cold"],
+        "coalesced_speedup": gates["measured_coalesced_speedup"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

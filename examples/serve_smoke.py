@@ -1,0 +1,150 @@
+"""``make serve-smoke``: boot the daemon, exercise it over the wire, shut
+down cleanly.
+
+The CI-sized end-to-end check of the serving subsystem (docs/SERVING.md):
+
+1. boots ``ServingDaemon`` on an ephemeral port (real HTTP, real threads);
+2. submits THREE requests over the wire — two structurally identical
+   (eta0 variants of one config: must coalesce into ONE run_batch cohort
+   and therefore ONE compile) and one structural outlier (its own
+   compile);
+3. asserts exactly 2 compiles for the 3 requests, the cohort/coalescing
+   facts in the returned manifests, and response correctness (the served
+   final gap equals a direct in-process ``jax_backend.run`` of the same
+   config over the same dataset);
+4. POSTs ``/v1/shutdown`` and verifies the server actually stopped.
+
+Exit code 0 = all assertions passed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _post(url, body, timeout=300.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url, timeout=300.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def main() -> int:
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    base = ExperimentConfig(
+        n_workers=8, n_samples=400, n_features=10,
+        n_informative_features=6, problem_type="logistic",
+        n_iterations=60, eval_every=20, local_batch_size=8,
+        dtype="float64",
+    )
+    # A window long enough that the two structurally identical requests
+    # land in the same scheduling cut over real HTTP round-trips.
+    opts = ServingOptions(window_s=0.3, max_cohort=32)
+    daemon = ServingDaemon(
+        "127.0.0.1", 0, opts,
+        service=SimulationService(opts, cache=ExecutableCache()),
+    )
+    daemon.start()
+    url = daemon.url
+    print(f"[serve-smoke] daemon at {url}", file=sys.stderr)
+    try:
+        # --- submit 3 requests over the wire (2 structurally identical) --
+        code_a, sub_a = _post(url + "/v1/submit", base.to_dict())
+        code_b, sub_b = _post(
+            url + "/v1/submit",
+            base.replace(learning_rate_eta0=0.11).to_dict(),
+        )
+        code_c, sub_c = _post(
+            url + "/v1/submit",
+            base.replace(topology="fully_connected").to_dict(),
+        )
+        assert (code_a, code_b, code_c) == (202, 202, 202), "submit failed"
+
+        manifests = {}
+        for sub in (sub_a, sub_b, sub_c):
+            code, m = _get(url + f"/v1/result/{sub['id']}?timeout=300")
+            assert code == 200 and m["kind"] == "run_trace", (code, m)
+            manifests[sub["id"]] = m
+
+        # --- one compile for the identical pair, one for the outlier ----
+        sa = manifests[sub_a["id"]]["health"]["serving"]
+        sb = manifests[sub_b["id"]]["health"]["serving"]
+        sc = manifests[sub_c["id"]]["health"]["serving"]
+        assert sa["cohort_size"] == 2 and sa["coalesced"], sa
+        assert sb["cohort_size"] == 2 and sb["coalesced"], sb
+        assert sc["cohort_size"] == 1 and not sc["coalesced"], sc
+        code, st = _get(url + "/v1/status")
+        assert code == 200
+        misses = st["cache"]["misses"]
+        assert misses == 2, (
+            f"expected exactly 2 compiles for 3 requests "
+            f"(coalesced pair + outlier), cache recorded {misses}"
+        )
+        print(
+            f"[serve-smoke] 3 requests -> {misses} compiles "
+            f"(pair coalesced at R=2), queue stats {st['cohorts']}",
+            file=sys.stderr,
+        )
+
+        # --- correctness over the wire: served gap == direct run --------
+        from distributed_optimization_tpu.backends import jax_backend
+        from distributed_optimization_tpu.utils.data import (
+            generate_synthetic_dataset,
+        )
+        from distributed_optimization_tpu.utils.oracle import (
+            compute_reference_optimum,
+        )
+
+        ds = generate_synthetic_dataset(base)
+        _, f_opt = compute_reference_optimum(ds, base.reg_param)
+        direct = jax_backend.run(base, ds, f_opt, executable_cache=False)
+        served_gap = manifests[sub_a["id"]]["health"]["final_gap"]
+        dev = abs(served_gap - float(direct.history.objective[-1]))
+        assert dev <= 1e-12, (
+            f"served final gap deviates from the direct run by {dev}"
+        )
+        print(f"[serve-smoke] parity OK (|dev| = {dev:.2e})", file=sys.stderr)
+
+        # --- clean shutdown over the wire -------------------------------
+        code, body = _post(url + "/v1/shutdown", {})
+        assert code == 200 and body["status"] == "shutting_down"
+        deadline = time.perf_counter() + 10.0
+        stopped = False
+        while time.perf_counter() < deadline:
+            try:
+                _get(url + "/v1/status", timeout=1.0)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                stopped = True
+                break
+            time.sleep(0.1)
+        assert stopped, "daemon still answering after /v1/shutdown"
+        print("[serve-smoke] clean shutdown confirmed", file=sys.stderr)
+    finally:
+        daemon.stop()
+    print("[serve-smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,589 @@
+"""Serving subsystem tests (ISSUE-7; docs/SERVING.md).
+
+The contracts pinned here:
+
+1. **Served == standalone.** A request resolved from a coalesced
+   ``run_batch`` cohort is the SAME run as a standalone ``run(cfg)`` over
+   the service's dataset — ≤ 1e-12 in float64 (the PR-4 replica-
+   equivalence convention, extended to the serving path), and a cached
+   executable re-executed for a new request produces bitwise the result a
+   fresh compile would have.
+2. **Structural hash.** Sweep (eta0 / clip_tau>0 / edge_drop>0) and seed
+   variants hash together; ANY non-sweepable difference — including the
+   zero/nonzero boundaries inside the sweepables — hashes apart, and two
+   configs differing only in a non-sweepable field MISS the cache (the
+   collision guard).
+3. **Cache mechanics.** LRU eviction by count, hit/miss/compile-seconds-
+   saved counters, reuse across seed variants with different datasets
+   (f* and data are traced inputs of the batched program).
+4. **Robustness.** Malformed/unknown/invalid configs are rejected with
+   structured errors at the submission boundary; a poison request that
+   passes field validation but fails in the backend takes down only its
+   own plan — in-flight cohorts complete and the service keeps serving.
+5. **Re-compile fix.** ``Simulator.run_one`` in one process compiles each
+   distinct program once (the process executable cache), and the report
+   carries the one-line serving summary.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.serving.cache import (
+    ExecutableCache,
+    process_executable_cache,
+)
+from distributed_optimization_tpu.serving.coalescer import (
+    plan_cohorts,
+    structural_group_key,
+    sweep_fields_for,
+    unbatchable_reason,
+)
+from distributed_optimization_tpu.serving.service import (
+    ServingError,
+    ServingOptions,
+    SimulationService,
+    parse_config,
+)
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+TOL = dict(rtol=1e-12, atol=1e-12)
+
+
+def _cfg(**kw) -> ExperimentConfig:
+    defaults = dict(
+        n_workers=8, n_samples=400, n_features=10, n_informative_features=6,
+        problem_type="logistic", n_iterations=40, topology="ring",
+        algorithm="dsgd", backend="jax", local_batch_size=8, eval_every=10,
+        dtype="float64",
+    )
+    defaults.update(kw)
+    return ExperimentConfig(**defaults)
+
+
+def _setup(cfg):
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(
+        ds, cfg.reg_param, huber_delta=cfg.huber_delta,
+        n_classes=cfg.n_classes,
+    )
+    return ds, f_opt
+
+
+def _service(**opts) -> SimulationService:
+    """A service with its OWN executable cache (never the process-global
+    one) so hit/miss assertions are deterministic under any test order."""
+    defaults = dict(window_s=0.0)
+    defaults.update(opts)
+    return SimulationService(
+        ServingOptions(**defaults), cache=ExecutableCache()
+    )
+
+
+# ---------------------------------------------------------- structural hash
+
+
+def test_structural_hash_ignores_seed_and_sweepables():
+    base = _cfg()
+    assert base.structural_hash() == _cfg(seed=999).structural_hash()
+    assert (
+        base.structural_hash()
+        == _cfg(learning_rate_eta0=0.31).structural_hash()
+    )
+    assert (
+        _cfg(edge_drop_prob=0.1).structural_hash()
+        == _cfg(edge_drop_prob=0.25, seed=7).structural_hash()
+    )
+    robust = dict(
+        aggregation="clipped_gossip", robust_b=1, attack="sign_flip",
+        n_byzantine=1,
+    )
+    assert (
+        _cfg(clip_tau=0.5, **robust).structural_hash()
+        == _cfg(clip_tau=2.0, **robust).structural_hash()
+    )
+    # data_seed only picks dataset VALUES (traced inputs), never the program.
+    assert base.structural_hash() == _cfg(data_seed=7).structural_hash()
+
+
+def test_structural_hash_zero_boundaries_and_structure():
+    base = _cfg()
+    # The sweepables' zero boundaries ARE structural: 0 traces a different
+    # program (no fault machinery / adaptive clipping radius).
+    assert (
+        base.structural_hash() != _cfg(edge_drop_prob=0.1).structural_hash()
+    )
+    robust = dict(
+        aggregation="clipped_gossip", robust_b=1, attack="sign_flip",
+        n_byzantine=1,
+    )
+    assert (
+        _cfg(clip_tau=0.0, **robust).structural_hash()
+        != _cfg(clip_tau=0.5, **robust).structural_hash()
+    )
+    # Non-sweepable fields hash apart.
+    for ov in (
+        dict(n_iterations=80, eval_every=10),
+        dict(topology="fully_connected"),
+        dict(algorithm="gradient_tracking"),
+        dict(telemetry=True),
+        dict(n_workers=10),
+    ):
+        assert base.structural_hash() != _cfg(**ov).structural_hash(), ov
+    # Random topologies bake the realized graph; deterministic ones don't.
+    er = _cfg(topology="erdos_renyi", n_workers=10)
+    assert (
+        er.structural_hash()
+        != er.replace(topology_seed=123).structural_hash()
+    )
+    assert (
+        base.structural_hash()
+        == base.replace(topology_seed=123).structural_hash()
+    )
+
+
+def test_collision_guard_nonsweepable_diff_misses_cache():
+    """Two configs differing only in a NON-sweepable field must MISS —
+    same-hash-but-different-program would serve wrong executables."""
+    cfg_a = _cfg()
+    cfg_b = _cfg(eval_every=20)
+    ds, f_opt = _setup(cfg_a)
+    cache = ExecutableCache()
+    jax_backend.run_batch(cfg_a, ds, f_opt, executable_cache=cache)
+    jax_backend.run_batch(cfg_b, ds, f_opt, executable_cache=cache)
+    assert cache.misses == 2 and cache.hits == 0
+
+
+# ------------------------------------------------------------ cache mechanics
+
+
+class _FakeExec:
+    def memory_analysis(self):
+        raise NotImplementedError
+
+
+def test_cache_lru_eviction_and_counters():
+    cache = ExecutableCache(max_entries=2)
+    for key in ("a", "b", "c"):
+        cache.put((key,), _FakeExec(), compile_seconds=1.5)
+    assert len(cache) == 2 and cache.evictions == 1
+    assert cache.get(("a",)) is None  # LRU'd out
+    entry = cache.get(("c",))
+    assert entry is not None and entry.hits == 1
+    assert cache.misses == 1 and cache.hits == 1
+    assert cache.compile_seconds_saved == pytest.approx(1.5)
+    stats = cache.stats()
+    assert stats["entries"] == 2 and stats["hit_rate"] == 0.5
+    cache.clear()
+    assert len(cache) == 0
+
+
+def test_sequential_run_cache_hit_is_bitwise():
+    cfg = _cfg()
+    ds, f_opt = _setup(cfg)
+    cache = ExecutableCache()
+    cold = jax_backend.run(cfg, ds, f_opt, executable_cache=cache)
+    warm = jax_backend.run(cfg, ds, f_opt, executable_cache=cache)
+    uncached = jax_backend.run(cfg, ds, f_opt, executable_cache=False)
+    assert cache.hits == 1 and cache.misses == 1
+    assert cold.history.compile_seconds > 0.0
+    assert warm.history.compile_seconds == 0.0
+    np.testing.assert_array_equal(
+        cold.history.objective, warm.history.objective
+    )
+    np.testing.assert_array_equal(cold.final_models, warm.final_models)
+    np.testing.assert_array_equal(cold.final_models, uncached.final_models)
+
+
+def test_batch_cache_reuse_across_seed_variants_same_bits():
+    """Seed variants generate DIFFERENT datasets and optima, yet reuse one
+    batched executable (data and f* are traced inputs) — and the reused
+    program computes exactly what a fresh compile computes."""
+    cfg_a = _cfg()
+    cfg_b = _cfg(seed=99)
+    ds_a, f_a = _setup(cfg_a)
+    ds_b, f_b = _setup(cfg_b)
+    cache = ExecutableCache()
+    jax_backend.run_batch(cfg_a, ds_a, f_a, executable_cache=cache)
+    warm = jax_backend.run_batch(cfg_b, ds_b, f_b, executable_cache=cache)
+    cold = jax_backend.run_batch(cfg_b, ds_b, f_b, executable_cache=False)
+    assert cache.misses == 1 and cache.hits == 1
+    assert warm.compile_seconds == 0.0
+    np.testing.assert_array_equal(cold.objective, warm.objective)
+    np.testing.assert_array_equal(
+        cold.final_states["x"], warm.final_states["x"]
+    )
+
+
+# --------------------------------------------------------------- coalescing
+
+
+class _Shim:
+    def __init__(self, config):
+        self.config = config
+
+
+def test_plan_cohorts_groups_and_chunks():
+    reqs = [
+        _Shim(_cfg(learning_rate_eta0=e)) for e in (0.05, 0.08, 0.1, 0.2)
+    ] + [_Shim(_cfg(topology="fully_connected"))]
+    plans = plan_cohorts(reqs, max_cohort=2)
+    sizes = sorted(p.size for p in plans)
+    assert sizes == [1, 2, 2]
+    assert all(p.sequential_reason is None for p in plans)
+    # Unbatchable configs become sequential singletons with the
+    # run_batch rejection text.
+    choco = _Shim(_cfg(algorithm="choco", lr_schedule="constant"))
+    plans = plan_cohorts([choco, _Shim(_cfg())], max_cohort=8)
+    seq = [p for p in plans if p.sequential_reason is not None]
+    assert len(seq) == 1 and "choco" in seq[0].sequential_reason
+
+
+def test_sweep_fields_follow_structural_class():
+    assert sweep_fields_for(_cfg()) == ("learning_rate_eta0",)
+    assert sweep_fields_for(_cfg(edge_drop_prob=0.1)) == (
+        "learning_rate_eta0", "edge_drop_prob",
+    )
+    robust = _cfg(
+        aggregation="clipped_gossip", robust_b=1, clip_tau=0.5,
+        attack="sign_flip", n_byzantine=1,
+    )
+    assert "clip_tau" in sweep_fields_for(robust)
+    assert unbatchable_reason(_cfg()) is None
+    assert "choco" in unbatchable_reason(
+        _cfg(algorithm="choco", lr_schedule="constant")
+    )
+
+
+def test_served_cohort_matches_standalone_run():
+    """The headline parity gate (tier-1): every request sliced from a
+    coalesced cohort — eta0 variants AND an identical repeat — equals the
+    standalone sequential run of its own config over the service's
+    dataset, ≤ 1e-12 in f64."""
+    svc = _service()
+    etas = (0.05, 0.08, 0.05)  # repeat included: duplicates may coalesce
+    ids = [
+        svc.submit(_cfg(learning_rate_eta0=e).to_dict()) for e in etas
+    ]
+    svc.drain()
+    reqs = [svc.result(i, timeout=5) for i in ids]
+    assert [r.cohort_size for r in reqs] == [3, 3, 3]
+    assert all(r.coalesced for r in reqs)
+    ds, f_opt = svc._dataset_for(reqs[0].config)
+    for req in reqs:
+        seq = jax_backend.run(req.config, ds, f_opt, executable_cache=False)
+        np.testing.assert_allclose(
+            req.result.history.objective, seq.history.objective, **TOL
+        )
+        np.testing.assert_allclose(
+            req.result.final_models, seq.final_models, **TOL
+        )
+        np.testing.assert_allclose(
+            req.result.history.consensus_error,
+            seq.history.consensus_error, **TOL,
+        )
+    # The two identical submissions must agree exactly (same replica
+    # program, same inputs).
+    np.testing.assert_array_equal(
+        reqs[0].result.final_models, reqs[2].result.final_models
+    )
+
+
+def test_served_faulty_byzantine_cohort_matches_standalone():
+    """Parity holds through the fault + Byzantine + robust-aggregation
+    composition with per-request edge_drop_prob on the sweep axis."""
+    mk = lambda p: _cfg(  # noqa: E731
+        edge_drop_prob=p, attack="sign_flip", n_byzantine=1,
+        aggregation="trimmed_mean", robust_b=1, partition="shuffled",
+    )
+    svc = _service()
+    ids = [svc.submit(mk(p)) for p in (0.1, 0.2)]
+    svc.drain()
+    reqs = [svc.result(i, timeout=5) for i in ids]
+    assert reqs[0].cohort_size == 2
+    ds, f_opt = svc._dataset_for(reqs[0].config)
+    for req in reqs:
+        seq = jax_backend.run(req.config, ds, f_opt, executable_cache=False)
+        np.testing.assert_allclose(
+            req.result.history.objective, seq.history.objective, **TOL
+        )
+        np.testing.assert_allclose(
+            req.result.final_models, seq.final_models, **TOL
+        )
+
+
+def test_seed_variants_separate_cohorts_shared_executable():
+    """Requests differing only in seed name DIFFERENT datasets (the seed
+    is sklearn's random_state), so they cannot share a cohort — but they
+    hash together and reuse one compiled executable."""
+    svc = _service()
+    ids = [svc.submit(_cfg(seed=s)) for s in (203, 99)]
+    svc.drain()
+    reqs = [svc.result(i, timeout=5) for i in ids]
+    assert [r.cohort_size for r in reqs] == [1, 1]
+    assert reqs[0].cache_hit is False and reqs[1].cache_hit is True
+    assert svc.cache.stats()["compile_seconds_saved"] > 0.0
+    for req in reqs:
+        ds, f_opt = svc._dataset_for(req.config)
+        seq = jax_backend.run(req.config, ds, f_opt, executable_cache=False)
+        np.testing.assert_allclose(
+            req.result.history.objective, seq.history.objective, **TOL
+        )
+
+
+def test_data_seed_pins_dataset_and_coalesces_seed_variants():
+    """With data_seed pinned, seed variants share the problem instance —
+    one cohort, one program execution — and each equals the standalone
+    run of its config over that shared dataset (the --seeds semantics,
+    now explicit)."""
+    svc = _service()
+    ids = [svc.submit(_cfg(seed=s, data_seed=7)) for s in (1, 2)]
+    svc.drain()
+    reqs = [svc.result(i, timeout=5) for i in ids]
+    assert [r.cohort_size for r in reqs] == [2, 2]
+    assert all(r.coalesced for r in reqs)
+    ds, f_opt = svc._dataset_for(reqs[0].config)
+    for req in reqs:
+        seq = jax_backend.run(req.config, ds, f_opt, executable_cache=False)
+        np.testing.assert_allclose(
+            req.result.final_models, seq.final_models, **TOL
+        )
+    # Different seeds really did run: trajectories differ.
+    assert not np.array_equal(
+        reqs[0].result.final_models, reqs[1].result.final_models
+    )
+
+
+def test_unbatchable_request_falls_back_sequential():
+    svc = _service()
+    cfg = _cfg(
+        algorithm="choco", lr_schedule="constant", compression="top_k",
+        compression_k=3,
+    )
+    rid = svc.submit(cfg)
+    svc.drain()
+    req = svc.result(rid, timeout=5)
+    assert req.status == "done" and not req.coalesced
+    assert "choco" in req.sequential_reason
+    assert svc.stats()["requests_sequential_fallback"] == 1
+    ds, f_opt = svc._dataset_for(cfg)
+    seq = jax_backend.run(cfg, ds, f_opt, executable_cache=False)
+    np.testing.assert_allclose(
+        req.result.history.objective, seq.history.objective, **TOL
+    )
+
+
+# ---------------------------------------------------------------- robustness
+
+
+def test_submit_rejects_structured():
+    svc = _service()
+    with pytest.raises(ServingError, match="unknown config fields"):
+        svc.submit({"bogus_field": 1})
+    with pytest.raises(ServingError, match="Unknown topology"):
+        svc.submit(_cfg().to_dict() | {"topology": "moebius"})
+    with pytest.raises(ServingError, match="JSON object"):
+        svc.submit([1, 2, 3])
+    with pytest.raises(ServingError, match="one request per seed"):
+        svc.submit(_cfg(replicas=4))
+    assert svc.queue_depth() == 0  # nothing poisoned the queue
+    with pytest.raises(ServingError, match="from_dict|unknown config"):
+        parse_config({"no_such": True})
+
+
+def test_queue_bound_rejects_not_buffers():
+    svc = _service(max_pending=1)
+    svc.submit(_cfg())
+    with pytest.raises(ServingError, match="queue full"):
+        svc.submit(_cfg(seed=5))
+    svc.drain()
+
+
+def test_done_history_is_bounded():
+    """A long-lived daemon rotates finished results out past max_done —
+    old ids answer 'unknown request' instead of pinning their payloads."""
+    svc = _service(max_done=2)
+    ids = [
+        svc.submit(_cfg(learning_rate_eta0=e)) for e in (0.05, 0.07, 0.09)
+    ]
+    svc.drain()
+    assert svc.result(ids[-1], timeout=5).status == "done"
+    with pytest.raises(KeyError, match=ids[0]):
+        svc.get(ids[0])
+    assert len(svc._requests) == 2
+
+
+def test_kill_switch_serves_uncached(monkeypatch):
+    """DOPT_EXEC_CACHE=0 must be honored by the serving layer too: no
+    explicit cache means COLD compiles, not a silent private cache."""
+    monkeypatch.setenv("DOPT_EXEC_CACHE", "0")
+    svc = SimulationService(ServingOptions(window_s=0.0))
+    assert svc.cache is None
+    ids = [svc.submit(_cfg()), svc.submit(_cfg())]
+    svc.drain()
+    reqs = [svc.result(i, timeout=5) for i in ids]
+    # Identical repeats still coalesce (one cohort, one compile) — but
+    # nothing is cached across plans and no hit is claimed.
+    assert all(r.status == "done" and r.cache_hit is None for r in reqs)
+    assert svc.stats()["cache"] == {"disabled": True}
+
+
+def test_poison_request_does_not_kill_inflight_cohorts():
+    """A config that passes field validation but is rejected by the
+    backend (robust budget > min degree) fails ALONE; the healthy cohort
+    cut in the same scheduling pass completes, and the service keeps
+    accepting work."""
+    svc = _service()
+    good = [
+        svc.submit(_cfg(learning_rate_eta0=e)) for e in (0.05, 0.08)
+    ]
+    poison = svc.submit(_cfg(
+        attack="sign_flip", n_byzantine=1, aggregation="trimmed_mean",
+        robust_b=3, partition="shuffled",  # 2*3 > ring min degree 2
+    ))
+    svc.drain()
+    preq = svc.result(poison, timeout=5)
+    assert preq.status == "failed" and "robust_b" in preq.error
+    for rid in good:
+        req = svc.result(rid, timeout=5)
+        assert req.status == "done" and req.cohort_size == 2
+    # Still serving after the poison.
+    rid = svc.submit(_cfg())
+    svc.drain()
+    assert svc.result(rid, timeout=5).status == "done"
+    stats = svc.stats()
+    assert stats["requests_failed"] == 1 and stats["requests_done"] == 3
+
+
+# ------------------------------------------------------------------- daemon
+
+
+def _post(url, body, timeout=120.0, raw=False):
+    req = urllib.request.Request(
+        url,
+        data=body if raw else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, timeout=30.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture()
+def daemon():
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+
+    d = ServingDaemon(
+        "127.0.0.1", 0, ServingOptions(window_s=0.01),
+        service=SimulationService(
+            ServingOptions(window_s=0.01), cache=ExecutableCache()
+        ),
+    )
+    d.start()
+    try:
+        yield d
+    finally:
+        d.stop()
+
+
+def test_daemon_run_submit_status_and_errors(daemon):
+    base = _cfg().to_dict()
+    # Submit-and-wait streams the manifest back as strict JSON(L).
+    code, manifest = _post(daemon.url + "/v1/run?timeout=120", base)
+    assert code == 200 and manifest["kind"] == "run_trace"
+    assert manifest["health"]["serving"]["cohort_size"] == 1
+    assert manifest["config"]["n_workers"] == base["n_workers"]
+    # Async submit + poll.
+    code, sub = _post(daemon.url + "/v1/submit",
+                      {"config": base | {"seed": 11}})
+    assert code == 202 and sub["status"] == "queued"
+    code, res = _get(
+        daemon.url + f"/v1/result/{sub['id']}?timeout=120"
+    )
+    assert code == 200 and res["kind"] == "run_trace"
+    assert res["label"] == sub["id"]
+    # Status carries queue + cache counters.
+    code, st = _get(daemon.url + "/v1/status")
+    assert code == 200 and st["status"] == "serving"
+    assert st["cache"]["misses"] >= 1
+    # Structured rejections: malformed JSON, unknown field, bad value,
+    # unknown id/endpoint — all without killing the daemon.
+    code, err = _post(daemon.url + "/v1/submit", b"{not json", raw=True)
+    assert code == 400 and err["error"] == "malformed_json"
+    code, err = _post(daemon.url + "/v1/submit", base | {"bogus": 1})
+    assert code == 400 and "bogus" in err["detail"]
+    code, err = _post(daemon.url + "/v1/submit",
+                      base | {"topology": "moebius"})
+    assert code == 400 and "Unknown topology" in err["detail"]
+    code, err = _get(daemon.url + "/v1/result/req-999999")
+    assert code == 404 and err["error"] == "unknown_request"
+    code, err = _get(daemon.url + "/v1/nope")
+    assert code == 404
+    # ... and the daemon still serves after all of them.
+    code, manifest = _post(
+        daemon.url + "/v1/run?timeout=120", base | {"seed": 12}
+    )
+    assert code == 200 and manifest["kind"] == "run_trace"
+
+
+def test_daemon_poison_run_returns_500_with_reason(daemon):
+    bad = _cfg(
+        attack="sign_flip", n_byzantine=1, aggregation="trimmed_mean",
+        robust_b=3, partition="shuffled",
+    ).to_dict()
+    code, err = _post(daemon.url + "/v1/run?timeout=120", bad)
+    assert code == 500 and err["error"] == "run_failed"
+    assert "robust_b" in err["detail"]
+    # In-flight capability intact.
+    code, manifest = _post(
+        daemon.url + "/v1/run?timeout=120", _cfg().to_dict()
+    )
+    assert code == 200 and manifest["kind"] == "run_trace"
+
+
+# -------------------------------------------------- re-compile waste fixed
+
+
+def test_simulator_compiles_each_program_once(capsys):
+    """Satellite: repeated identical run_one calls (and repeated CLI
+    invocations in one process) hit the process executable cache — the
+    second run's compile phase is gone and the report says so."""
+    from distributed_optimization_tpu.simulator import Simulator
+
+    cache = process_executable_cache()
+    assert cache is not None, "process cache must be on by default"
+    cfg = _cfg(n_iterations=30, eval_every=10, n_samples=360, seed=31337)
+    sim = Simulator(cfg)
+    rec1 = sim.run_one(verbose=False)
+    rec2 = sim.run_one(verbose=False)
+    assert rec1.result.history.compile_seconds > 0.0
+    assert rec2.result.history.compile_seconds == 0.0
+    text = sim.report_numerical_results()
+    capsys.readouterr()
+    assert "serving: cache" in text and "compile saved" in text
+
+
+def test_process_cache_env_kill_switch(monkeypatch):
+    import distributed_optimization_tpu.serving.cache as cache_mod
+
+    monkeypatch.setenv("DOPT_EXEC_CACHE", "0")
+    assert cache_mod.process_executable_cache() is None
+    monkeypatch.delenv("DOPT_EXEC_CACHE")
+    assert cache_mod.process_executable_cache() is not None

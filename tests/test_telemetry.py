@@ -377,6 +377,9 @@ PERF_ARTIFACT_KEYS = {
     "robust_scale.json": {
         "crossover_n64", "device", "headline_n256_ring", "note", "protocol"},
     "scaling.json": {"config", "device", "rows"},
+    "serving.json": {
+        "device", "platform", "protocol", "note", "workload", "latency",
+        "throughput", "parity", "gates"},
     "sparse_mixing.json": {
         "device", "end_to_end", "note", "op_level", "protocol"},
     "sweep.json": {
