@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test smoke serve-smoke bench-byzantine bench-churn \
 	bench-robust-scale bench-sweep bench-compute bench-telemetry \
-	bench-fused bench-serving
+	bench-fused bench-serving bench-federated
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -21,7 +21,8 @@ smoke:
 		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py \
 		tests/test_robust_gather.py tests/test_fused_robust.py \
 		tests/test_compressed_gossip.py tests/test_batch.py \
-		tests/test_telemetry.py tests/test_serving.py
+		tests/test_telemetry.py tests/test_serving.py \
+		tests/test_federated.py
 
 # End-to-end serving smoke over real HTTP (docs/SERVING.md): boot the
 # daemon, submit 3 requests (2 structurally identical -> ONE compile via
@@ -69,6 +70,13 @@ bench-telemetry:
 # and bytes-vs-gap envelopes for {none,top_k,qsgd} x {dsgd,gt}).
 bench-fused:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_fused_robust.py
+
+# Regenerate the federated-regime evidence (docs/perf/federated.json:
+# local-steps floats-to-eps reduction >= 2x floor, participation-rate
+# convergence curves + q^2 cost model, matrix-free throughput/memory
+# cells with the N=10k completion asserted).
+bench-federated:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_federated.py
 
 # Regenerate the serving-layer evidence (docs/perf/serving.json:
 # executable-cache warm-vs-cold submit->start latency >= 10x floor,

@@ -108,10 +108,51 @@ class Algorithm:
     # breaks the column-stochastic mass conservation its debiasing needs),
     # and the centralized pattern (no peer edges to attack).
     supports_byzantine: bool = False
+    # Whether the step rule accepts ``config.local_steps`` > 1 — τ gradient
+    # descents per gossip round, the federated local-update regime
+    # (Koloskova et al. '20; docs/PERF.md §14). True only for rules whose
+    # round structure survives extra purely-local descents: D-SGD (plain
+    # local SGD between gossips) and gradient tracking (tracker-corrected
+    # local steps). config.LOCAL_STEP_ALGORITHMS mirrors this flag so
+    # validation stays jax-free.
+    supports_local_steps: bool = False
     # Optional override of the per-edge float payload for comms accounting:
     # (config, d) -> floats per edge per iteration. None = d · gossip_rounds
     # (full-vector exchange). Compressed-gossip algorithms set this.
     comm_payload: Optional[Callable[[Any, int], float]] = None
+
+
+# Python-unroll budget for the τ−1 extra local descents inside one scan
+# trip: beyond it the jax path switches to ``lax.fori_loop`` so program
+# size stays bounded (the numpy oracle always takes the Python loop).
+LOCAL_UNROLL_MAX = 8
+
+
+def local_descent_loop(v: Array, ctx: "StepContext", direction) -> Array:
+    """Run the round's τ−1 extra LOCAL descents (``config.local_steps``).
+
+    ``direction(v, s)`` maps the current iterate and the in-round slot
+    index s ∈ [1, τ) to the descent direction for that local step (plain
+    ``ctx.grad(v, s)`` for D-SGD; the tracker-corrected direction for
+    gradient tracking). τ = 1 returns ``v`` untouched — ZERO added ops,
+    which is what makes the τ=1 reduction bitwise. Unrolled in Python up
+    to ``LOCAL_UNROLL_MAX`` (also the only form the backend-polymorphic
+    numpy path takes); larger τ on the jax backend runs a ``fori_loop``
+    (the slot index reaches ``grad`` as traced data — counter-based batch
+    keys fold it in like any other integer).
+    """
+    tau = ctx.config.local_steps
+    if tau <= 1:
+        return v
+    if ctx.config.backend == "jax" and tau - 1 > LOCAL_UNROLL_MAX:
+        from jax import lax
+
+        return lax.fori_loop(
+            1, tau, lambda s, vv: vv - ctx.eta * direction(vv, s), v
+        )
+    for s in range(1, tau):
+        v = v - ctx.eta * direction(v, s)
+    return v
 
 
 _REGISTRY: dict[str, Algorithm] = {}

@@ -35,6 +35,7 @@ from distributed_optimization_tpu.algorithms.base import (
     Algorithm,
     State,
     StepContext,
+    local_descent_loop,
     register_algorithm,
 )
 
@@ -77,8 +78,16 @@ def _step(state: State, ctx: StepContext) -> State:
     grads = ctx.grad(x, 0)  # at the local pre-mix models (D-PSGD ordering)
     if ctx.fused_mix_step is not None:
         # Backend-fused W x − eta g (single pallas kernel, one HBM pass).
-        return {"x": ctx.fused_mix_step(x, grads, ctx.eta)}
-    x_new = ctx.mix(x) - ctx.eta * grads
+        x_new = ctx.fused_mix_step(x, grads, ctx.eta)
+    else:
+        x_new = ctx.mix(x) - ctx.eta * grads
+    # Federated local updates (config.local_steps = τ; docs/PERF.md §14):
+    # the gossip-fused first descent above is local step 0 of the round;
+    # τ−1 purely-local SGD descents follow, each on its own batch draw
+    # (slot s) at the round's step size — Koloskova et al. '20's
+    # local-update regime with the D-PSGD ordering kept for step 0, so
+    # τ = 1 is bitwise the historical one-step round.
+    x_new = local_descent_loop(x_new, ctx, lambda v, s: ctx.grad(v, s))
     return {"x": x_new}
 
 
@@ -95,5 +104,5 @@ def _comm_payload(config, d: int) -> float:
 DSGD = register_algorithm(
     Algorithm(name="dsgd", init=_init, step=_step, gossip_rounds=1,
               supports_byzantine=True, supports_churn=True,
-              comm_payload=_comm_payload)
+              supports_local_steps=True, comm_payload=_comm_payload)
 )

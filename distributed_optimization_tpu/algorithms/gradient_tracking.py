@@ -42,6 +42,7 @@ from distributed_optimization_tpu.algorithms.base import (
     Algorithm,
     State,
     StepContext,
+    local_descent_loop,
     register_algorithm,
 )
 
@@ -102,7 +103,20 @@ def _step(state: State, ctx: StepContext) -> State:
     x_new = ctx.mix(x) - ctx.eta * y
     g_new = ctx.grad(x_new, 0)
     y_new = ctx.mix(y) + g_new - g_prev
-    return {"x": x_new, "y": y_new, "g_prev": g_new}
+    # Federated local updates (config.local_steps = τ; docs/PERF.md §14):
+    # τ−1 extra LOCAL descents along the tracker-corrected direction
+    # y_new + (g(v, s) − g_new) — the K-GT-style drift correction: the
+    # tracker supplies the network-average gradient estimate and the
+    # local term only contributes its deviation from the round's base
+    # gradient, so local steps keep GT's heterogeneity correction
+    # instead of re-introducing client drift. The tracker recursion
+    # itself is untouched (y_new above), so the tracking invariant
+    # mean(y_t) = mean(g_prev_t) holds for every τ, and τ = 1 adds zero
+    # ops — bitwise the historical round.
+    v = local_descent_loop(
+        x_new, ctx, lambda vv, s: y_new + ctx.grad(vv, s) - g_new
+    )
+    return {"x": v, "y": y_new, "g_prev": g_new}
 
 
 def _comm_payload(config, d: int) -> float:
@@ -118,5 +132,5 @@ def _comm_payload(config, d: int) -> float:
 GRADIENT_TRACKING = register_algorithm(
     Algorithm(name="gradient_tracking", init=_init, step=_step,
               gossip_rounds=2, supports_byzantine=True, supports_churn=True,
-              comm_payload=_comm_payload)
+              supports_local_steps=True, comm_payload=_comm_payload)
 )

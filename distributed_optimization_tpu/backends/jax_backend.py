@@ -289,13 +289,14 @@ def _make_step_eval(p: _StepPieces, data):
         new_state = p.algo.step(state, ctx)
         if faulty is not None and (
             faulty.straggler_prob > 0.0 or faulty.churn_active
+            or faulty.participation_active
         ):
-            # A straggler/crashed node takes no step at all: freeze its
-            # rows across every state leaf (each leaf leads with the
-            # worker axis) — for churn, across the WHOLE outage, so a
-            # 'frozen' rejoin resumes the stale pre-crash state for
-            # free. Its mixing row already degenerated to identity via
-            # the dropped edges.
+            # A straggler/crashed/sampled-out node takes no step at all:
+            # freeze its rows across every state leaf (each leaf leads
+            # with the worker axis) — for churn, across the WHOLE
+            # outage, so a 'frozen' rejoin resumes the stale pre-crash
+            # state for free. Its mixing row already degenerated to
+            # identity via the dropped edges.
             m = faulty.active(t)
             new_state = jax.tree.map(
                 lambda new, old: jnp.where(
@@ -448,6 +449,7 @@ def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
         config.edge_drop_prob > 0.0
         or config.straggler_prob > 0.0
         or config.mttf > 0.0
+        or config.participation_rate < 1.0
         or config.gossip_schedule != "synchronous"
         or drop_prob is not None
     )
@@ -457,10 +459,10 @@ def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
         raise ValueError(
             f"time-varying gossip is unsupported for {algo.name!r}: "
             "the step rule is not faithful under per-iteration "
-            "graphs (ADMM pairs neighbor sums with static degrees; "
-            "CHOCO's shared estimate state cannot represent "
-            "undelivered updates; EXTRA's fixed-point argument "
-            "requires a static W)"
+            "graphs — participation sampling included (ADMM pairs "
+            "neighbor sums with static degrees; CHOCO's shared "
+            "estimate state cannot represent undelivered updates; "
+            "EXTRA's fixed-point argument requires a static W)"
         )
     if config.mttf > 0.0 and not algo.supports_churn:
         raise ValueError(
@@ -485,6 +487,7 @@ def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
         rejoin=config.rejoin,
         horizon=T if horizon is None else horizon,
         keys=keys, timeline=timeline,
+        participation_rate=config.participation_rate,
     )
 
 
@@ -567,6 +570,11 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
             and fused_auto_ok
             and faulty is None
             and not config.telemetry
+            # The fused-kernel measurement covers the one-step round; with
+            # τ local steps auto stays on gather (an EXPLICIT 'fused'
+            # still runs — the kernel is the round's first descent and the
+            # τ−1 local steps follow outside it).
+            and config.local_steps == 1
             and fused_robust_supported(config.aggregation, k_max_topo, ct)
         )
         robust_impl = config.resolved_robust_impl(
@@ -1118,10 +1126,17 @@ def _run(
         topo = build_topology(
             config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
             seed=config.resolved_topology_seed(),
+            impl=config.resolved_topology_impl(),
         )
-        if mesh is None and use_mesh and len(jax.devices()) > 1:
+        if (
+            mesh is None and use_mesh and len(jax.devices()) > 1
+            and not topo.is_matrix_free
+        ):
             # The shard_map grid stencil blocks grid ROWS over devices, so the
-            # mesh size must divide the row count, not just N.
+            # mesh size must divide the row count, not just N. The
+            # matrix-free path runs unsharded: its regime is the huge-N
+            # single-process simulation (the replica axis fills the chip),
+            # and gather indices under GSPMD would all-gather anyway.
             if config.mixing_impl == "shard_map" and topo.grid_shape is not None:
                 mesh = make_worker_mesh(topo.grid_shape[0])
             else:
@@ -1153,6 +1168,7 @@ def _run(
             config.edge_drop_prob > 0.0
             or config.straggler_prob > 0.0
             or config.mttf > 0.0
+            or config.participation_rate < 1.0
             or config.gossip_schedule != "synchronous"
         )
         byzantine_active = config.attack != "none" or (
@@ -1189,7 +1205,9 @@ def _run(
                 fused_auto_ok=mesh is None,
             )
         )
-        static_degree_sum = float(np.asarray(topo.adjacency).sum())
+        # == adjacency.sum() for both orientations; degree-based so the
+        # matrix-free representation needs no [N, N] array.
+        static_degree_sum = float(np.asarray(topo.degrees).sum())
     else:
         if (
             config.edge_drop_prob > 0.0
@@ -1961,6 +1979,13 @@ def _run_batch(
         topo = build_topology(
             config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
             seed=config.resolved_topology_seed(),
+            # Resolve from a PER-REPLICA config, not the base: a swept
+            # edge_drop_prob axis (base 0.0, positive per replica) is a
+            # dense-only feature the base config's auto rule cannot see —
+            # all rep_cfgs resolve identically because swept edge values
+            # are validated positive above, and each rep_cfg IS the
+            # sequential run this batch must reproduce.
+            impl=rep_cfgs[0].resolved_topology_impl(),
         )
         mix_op = make_mixing_op(
             topo, impl=config.mixing_impl, dtype=device_data.X.dtype
@@ -1987,13 +2012,20 @@ def _run_batch(
         config.edge_drop_prob > 0.0
         or config.straggler_prob > 0.0
         or config.mttf > 0.0
+        or config.participation_rate < 1.0
         or config.gossip_schedule != "synchronous"
         or "edge_drop_prob" in sweep
     )
     byzantine_active = config.attack != "none" or (
         config.aggregation != "gossip" and config.robust_b > 0
     )
-    use_timeline = config.burst_len >= 1.0 or config.mttf > 0.0
+    use_timeline = (
+        config.burst_len >= 1.0 or config.mttf > 0.0
+        or config.participation_rate < 1.0
+        # Matrix-free node faults always route through the timeline
+        # (parallel/faults.py convention — bitwise the iid draws).
+        or (topo is not None and topo.is_matrix_free and time_varying)
+    )
 
     # --- per-replica randomness, derived host-side ---------------------
     # Identical formulas to the sequential path's (jax.random.key(seed) +
@@ -2018,6 +2050,7 @@ def _run_batch(
                 burst_len=c.burst_len if c.burst_len >= 1.0 else 1.0,
                 straggler_prob=0.0 if c.mttf > 0.0 else c.straggler_prob,
                 mttf=c.mttf, mttr=c.mttr,
+                participation_rate=c.participation_rate,
             )
             for c in rep_cfgs
         ])
@@ -2027,6 +2060,8 @@ def _run_batch(
             rp["tl_node_up"] = jnp.asarray(stacked_tl.node_up)
         if stacked_tl.rejoin is not None:
             rp["tl_rejoin"] = jnp.asarray(stacked_tl.rejoin)
+        if stacked_tl.part_up is not None:
+            rp["tl_part_up"] = jnp.asarray(stacked_tl.part_up)
     byz_hosts = None
     if byzantine_active and config.attack != "none":
         byz_hosts = np.stack([
@@ -2107,7 +2142,7 @@ def _run_batch(
     n_trips = n_evals * trips_per_eval
 
     static_degree_sum = (
-        float(np.asarray(topo.adjacency).sum()) if topo is not None else 0.0
+        float(np.asarray(topo.degrees).sum()) if topo is not None else 0.0
     )
 
     def replica_scan(rp_r, state_init, t0_dev, data):
@@ -2128,6 +2163,7 @@ def _run_batch(
                     edge_up=rp_r.get("tl_edge_up"),
                     node_up=rp_r.get("tl_node_up"),
                     rejoin=rp_r.get("tl_rejoin"),
+                    part_up=rp_r.get("tl_part_up"),
                 )
             if time_varying:
                 faulty = _build_faulty(

@@ -111,6 +111,7 @@ def run(
         config.edge_drop_prob > 0.0
         or config.straggler_prob > 0.0
         or config.mttf > 0.0
+        or config.participation_rate < 1.0
     )
     if faults_active:
         if not algo.is_decentralized:
@@ -228,7 +229,23 @@ def run(
                 0.0 if config.mttf > 0.0 else config.straggler_prob
             ),
             mttf=config.mttf, mttr=config.mttr,
+            participation_rate=config.participation_rate,
         )
+
+        def _up_row(t: int) -> Optional[np.ndarray]:
+            """Composed [N] bool availability at round t: churn/straggler-up
+            AND sampled-in (participation) — the independent float64 twin
+            of the jax path's composed ``active(t)``. None when no node
+            process is active."""
+            up = None
+            if timeline.node_up is not None:
+                up = timeline.node_up[t]
+            if timeline.part_up is not None:
+                up = (
+                    timeline.part_up[t] if up is None
+                    else up & timeline.part_up[t]
+                )
+            return up
 
         def _realized_A(t: int) -> np.ndarray:
             if timeline.edge_up is not None:
@@ -241,8 +258,9 @@ def run(
                     A_t[ej, ei] = vals
             else:
                 A_t = np.asarray(A, dtype=np.float64).copy()
-            if timeline.node_up is not None:
-                m = timeline.node_up[t].astype(np.float64)
+            up = _up_row(t)
+            if up is not None:
+                m = up.astype(np.float64)
                 A_t *= m[:, None] * m[None, :]  # down node exchanges nothing
             return A_t
 
@@ -395,15 +413,20 @@ def run(
             # faults the realized W_t is read through `live`.
             gossip = byz_mix if byz is not None else (lambda v: live["W"] @ v)
             state = {"x": zeros.copy(), "y": zeros.copy(), "g": zeros.copy()}
+            tau_gt = config.local_steps
 
             def matrix_step(state, t, eta, grad_at):
                 x_new = gossip(state["x"]) - eta * state["y"]
                 g_new = grad_at(x_new)
-                return {
-                    "x": x_new,
-                    "y": gossip(state["y"]) + g_new - state["g"],
-                    "g": g_new,
-                }
+                y_new = gossip(state["y"]) + g_new - state["g"]
+                # Federated local updates (config.local_steps = τ): τ−1
+                # extra LOCAL descents along the tracker-corrected
+                # direction y_new + (g(v) − g_new) — the independent
+                # float64 twin of the jax rule's K-GT-style recursion
+                # (algorithms/gradient_tracking.py). τ = 1 adds no ops.
+                for _ in range(1, tau_gt):
+                    x_new = x_new - eta * (y_new + grad_at(x_new) - g_new)
+                return {"x": x_new, "y": y_new, "g": g_new}
 
         elif config.algorithm == "extra":
             # EXTRA (Shi et al. 2015):
@@ -548,9 +571,10 @@ def run(
             live_edges = float(np.asarray(live["A"]).sum())
         else:
             live_edges = 0.0
+        up_row = _up_row(t) if timeline is not None else None
         nodes = (
-            timeline.node_up[t].astype(np.float32)
-            if timeline is not None and timeline.node_up is not None
+            up_row.astype(np.float32)
+            if up_row is not None
             else np.ones(n, dtype=np.float32)
         )
         cf = 0.0
@@ -617,11 +641,14 @@ def run(
                 config=config,
             )
             state = algo.step(state, ctx)
-        if timeline is not None and timeline.node_up is not None:
-            # A down node takes no step at all: freeze its rows across
-            # every state leaf — for churn, across the WHOLE outage, so a
-            # 'frozen' rejoin resumes the stale pre-crash state for free.
-            up = timeline.node_up[t]
+        if timeline is not None and (
+            timeline.node_up is not None or timeline.part_up is not None
+        ):
+            # A down/sampled-out node takes no step at all: freeze its
+            # rows across every state leaf — for churn, across the WHOLE
+            # outage, so a 'frozen' rejoin resumes the stale pre-crash
+            # state for free.
+            up = _up_row(t)
             state = {
                 k: np.where(
                     up.reshape((-1,) + (1,) * (v.ndim - 1)), v, prev_state[k]

@@ -33,6 +33,7 @@ from distributed_optimization_tpu.config import (
     ATTACKS,
     BACKENDS,
     COMPRESSIONS,
+    MATRIX_FREE_AUTO_N,
     PROBLEM_TYPES,
     REJOINS,
     TOPOLOGIES,
@@ -172,6 +173,23 @@ def build_parser() -> argparse.ArgumentParser:
     opt.add_argument("--choco-gamma", type=float, default=_DEFAULTS.choco_gamma,
                      help="error-feedback consensus step size gamma "
                           "(CHOCO and compressed dsgd/gradient_tracking)")
+    opt.add_argument("--local-steps", type=int, default=_DEFAULTS.local_steps,
+                     help="federated local updates: τ gradient steps per "
+                          "gossip round, fused in the same compiled scan "
+                          "(dsgd: plain local SGD; gradient_tracking: "
+                          "tracker-corrected). Per-round comms is "
+                          "unchanged, so τ>1 cuts floats per unit of "
+                          "progress up to τ× (docs/PERF.md §14). 1 = the "
+                          "classic one-step round, bitwise")
+    opt.add_argument("--participation-rate", type=float,
+                     default=_DEFAULTS.participation_rate,
+                     help="per-round client sampling: each worker "
+                          "independently participates with this "
+                          "probability (presampled [horizon, N] masks on "
+                          "the fault timeline; sampled-out workers freeze "
+                          "and exchange nothing; composes with churn and "
+                          "the Byzantine layer). 1.0 = everyone, bitwise "
+                          "the no-sampling program")
     opt.add_argument("--edge-drop-prob", type=float,
                      default=_DEFAULTS.edge_drop_prob,
                      help="failure injection: per-iteration probability that "
@@ -298,8 +316,25 @@ def build_parser() -> argparse.ArgumentParser:
                             "parity)")
     execg.add_argument("--mixing-impl",
                        choices=("auto", "dense", "stencil", "shard_map",
-                                "pallas", "sparse"),
-                       default=_DEFAULTS.mixing_impl)
+                                "pallas", "sparse", "gather"),
+                       default=_DEFAULTS.mixing_impl,
+                       help="'gather' = the k_max-bounded neighbor-table "
+                            "mixing operator, O(N*k_max*d) per round with "
+                            "no [N,N] matrix — the matrix-free/federated-"
+                            "scale route (auto picks it on matrix-free "
+                            "topologies and above the measured dense "
+                            "crossover; docs/PERF.md §14)")
+    execg.add_argument("--topology-impl",
+                       choices=("auto", "dense", "neighbor"),
+                       default=_DEFAULTS.topology_impl,
+                       help="topology representation: 'neighbor' builds "
+                            "the matrix-free padded [N, k_max] neighbor "
+                            "table (ring/grid/chain/erdos_renyi; the only "
+                            "form that fits N >= 10k), 'dense' the "
+                            "[N, N] matrices; 'auto' = neighbor on the "
+                            "jax backend above "
+                            f"{MATRIX_FREE_AUTO_N} workers when no "
+                            "dense-only feature is requested")
     execg.add_argument("--sampling-impl",
                        choices=("auto", "gather", "dense"),
                        default=_DEFAULTS.sampling_impl,
@@ -399,6 +434,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         compression=args.compression,
         compression_k=args.compression_k,
         choco_gamma=args.choco_gamma,
+        local_steps=args.local_steps,
+        participation_rate=args.participation_rate,
+        topology_impl=args.topology_impl,
         seed=args.seed,
         topology_seed=args.topology_seed,
         data_seed=args.data_seed,

@@ -70,6 +70,35 @@ REJOINS = ("frozen", "neighbor_restart")
 # with robust_b == 0 degrades to exactly plain gossip.
 AGGREGATIONS = ("gossip", "trimmed_mean", "median", "clipped_gossip")
 
+# Algorithms that accept ``local_steps`` > 1 (τ gradient steps per gossip
+# round — the federated local-update regime of Koloskova et al. '20's
+# unified theory; docs/PERF.md §14). Only mix-based rules whose round
+# structure survives extra purely-local descents qualify: D-SGD (plain
+# local SGD between gossips) and gradient tracking (tracker-corrected
+# local steps, K-GT style). EXTRA/ADMM/CHOCO/push-sum each pin a
+# one-exchange-per-descent recursion that τ local steps would silently
+# break.
+LOCAL_STEP_ALGORITHMS = ("dsgd", "gradient_tracking")
+
+# Topologies with a neighbor-table-native (matrix-free) constructor
+# (parallel/topology.py): the graph is built directly as a padded
+# [N, k_max] neighbor table without ever materializing the dense [N, N]
+# adjacency or mixing matrix — the representation that lifts the worker
+# axis to N in the tens of thousands (the dense path's [N, N] float64
+# state is ~800 MB at N = 10k). fully_connected/star are deliberately
+# excluded: their k_max is N−1, so the "table" would be the quadratic
+# object the path exists to avoid (build_topology rejects them loudly).
+NEIGHBOR_TOPOLOGIES = ("ring", "grid", "chain", "erdos_renyi")
+
+# N at which ``topology_impl='auto'`` switches to the matrix-free neighbor
+# path (and mixing_impl='auto' to the k_max-bounded gather operator on
+# matrix-backed irregular graphs): the dense-mixing measurements stop at
+# N = 4096 — the axis cap docs/perf/sparse_mixing.json records — and the
+# federated-scale bench (docs/perf/federated.json) measures the gather
+# route winning on CPU well below it while being the only route that
+# completes at N >= 10k.
+MATRIX_FREE_AUTO_N = 4096
+
 # Per-replica scalar axes ``jax_backend.run_batch`` can sweep alongside the
 # seed axis (each replica r behaves exactly like a sequential run of
 # ``config.replace(seed=seeds[r], **{field: values[r]})``). Only scalars
@@ -220,6 +249,37 @@ class ExperimentConfig:
     attack: str = "none"
     n_byzantine: int = 0
     attack_scale: float = 1.0
+    # --- federated execution regime (docs/PERF.md §14) ---
+    # τ local SGD steps per gossip round (Koloskova et al. '20 local
+    # updates): each scan iteration is one ROUND — the algorithm's normal
+    # gossip-fused first descent plus τ−1 purely-local descents (tracker-
+    # corrected for gradient_tracking), all fused inside the same compiled
+    # scan body. Per-round comms is unchanged, so τ is the dominant
+    # communication-reduction lever: τ gradient steps per exchanged model
+    # ⇒ up to τ× fewer floats per unit of progress (measured in
+    # docs/perf/federated.json). 1 = the existing one-step round, bitwise.
+    local_steps: int = 1
+    # Per-round partial participation (client sampling): each round, every
+    # worker independently participates with this probability, presampled
+    # into the run's fault timeline ([horizon, N] masks — the same
+    # machinery as stragglers/churn, distinct key stream). A sampled-out
+    # worker exchanges nothing and takes no local step that round (its
+    # state is frozen); gossip reweights on the realized subgraph via the
+    # realized-adjacency composition, so participation composes with
+    # churn, bursty links and the Byzantine layer. 1.0 = everyone, every
+    # round — bitwise the no-sampling program (no fault machinery traced).
+    participation_rate: float = 1.0
+    # 'auto' | 'dense' | 'neighbor'. Topology representation: 'dense'
+    # builds the [N, N] adjacency + mixing matrix (every pre-federated
+    # path); 'neighbor' is the matrix-free form — a padded [N, k_max]
+    # neighbor table with gather-form MH mixing, matrix-free spectral-gap
+    # diagnostics, and O(N·k_max·d) per-round work/memory, the only
+    # representation that fits N in the tens of thousands. 'auto' picks
+    # 'neighbor' on the jax backend above MATRIX_FREE_AUTO_N workers for
+    # NEIGHBOR_TOPOLOGIES when no dense-only feature (edge-fault
+    # processes, Byzantine screening, matching schedules, matrix-backed
+    # mixing impls) is requested; 'dense' otherwise.
+    topology_impl: str = "auto"
     # Robust neighbor aggregation (defense): which rule honest workers use
     # to combine received neighbor models, and its per-neighborhood attack
     # budget b (values trimmed from each tail / messages assumed Byzantine).
@@ -313,7 +373,7 @@ class ExperimentConfig:
         if self.backend not in BACKENDS:
             raise ValueError(f"Unknown backend: {self.backend}")
         if self.mixing_impl not in ("auto", "dense", "stencil", "shard_map",
-                                    "pallas", "sparse"):
+                                    "pallas", "sparse", "gather"):
             raise ValueError(f"Unknown mixing impl: {self.mixing_impl}")
         if self.sampling_impl not in ("auto", "gather", "dense"):
             raise ValueError(f"Unknown sampling impl: {self.sampling_impl}")
@@ -503,6 +563,139 @@ class ExperimentConfig:
                 "crash-recovery churn (mttf/mttr); without outages there "
                 "are no rejoin rounds and it would be silently ignored"
             )
+        if self.local_steps < 1:
+            raise ValueError(
+                f"local_steps must be >= 1, got {self.local_steps}"
+            )
+        if self.local_steps > 1:
+            if self.algorithm not in LOCAL_STEP_ALGORITHMS:
+                raise ValueError(
+                    f"local_steps={self.local_steps} is unsupported for "
+                    f"{self.algorithm!r}: τ local descents between gossip "
+                    "exchanges only compose with the mix-based rules "
+                    f"{LOCAL_STEP_ALGORITHMS} (EXTRA/ADMM/CHOCO/push-sum "
+                    "pin a one-exchange-per-descent recursion that extra "
+                    "local steps would silently break)"
+                )
+            if self.compression != "none":
+                raise ValueError(
+                    "local_steps > 1 does not compose with compressed "
+                    "gossip: the error-feedback estimate exchange assumes "
+                    "one descent per transmitted difference — τ local "
+                    "steps between exchanges would leave the shared X̂ "
+                    "tracking a state it never saw"
+                )
+            if self.backend == "cpp":
+                raise ValueError(
+                    "local_steps > 1 is unsupported on the cpp backend "
+                    "(its native kernel hard-codes the one-step round); "
+                    "use backend='jax' or 'numpy'"
+                )
+            if self.tp_degree > 1:
+                raise ValueError(
+                    "local_steps > 1 does not compose with tp_degree > 1: "
+                    "the tensor-parallel path runs its own sharded "
+                    "one-step ring stencil"
+                )
+        if not 0.0 < self.participation_rate <= 1.0:
+            raise ValueError(
+                f"participation_rate must be in (0, 1], got "
+                f"{self.participation_rate}"
+            )
+        if self.participation_rate < 1.0:
+            if self.algorithm == "centralized":
+                raise ValueError(
+                    "participation_rate models per-round client sampling "
+                    "of peer exchanges; the centralized pattern has no "
+                    "peer edges — it applies to decentralized algorithms "
+                    "only"
+                )
+            if self.gossip_schedule != "synchronous":
+                raise ValueError(
+                    "participation_rate < 1 requires "
+                    "gossip_schedule='synchronous': the sampled subgraph "
+                    "reweights the whole realized neighborhood, which "
+                    f"matching schedules ({self.gossip_schedule!r}) "
+                    "cannot supply"
+                )
+            if self.compression != "none":
+                raise ValueError(
+                    "participation_rate < 1 does not compose with "
+                    "compressed gossip (same reason as edge faults: a "
+                    "sampled-out round leaves neighbors' error-feedback "
+                    "estimates stale) — sample participation uncompressed"
+                )
+            if self.backend == "cpp":
+                raise ValueError(
+                    "participation_rate < 1 is unsupported on the cpp "
+                    "backend; use backend='jax' (or the numpy oracle)"
+                )
+            if self.tp_degree > 1:
+                raise ValueError(
+                    "participation_rate < 1 does not compose with "
+                    "tp_degree > 1: the TP ring stencil is a fixed "
+                    "boundary exchange, not a per-round realized graph"
+                )
+        if self.topology_impl not in ("auto", "dense", "neighbor"):
+            raise ValueError(f"Unknown topology impl: {self.topology_impl}")
+        if self.topology_impl == "neighbor":
+            if self.topology == "fully_connected":
+                raise ValueError(
+                    "topology_impl='neighbor' with 'fully_connected' would "
+                    "allocate an [N, N-1] neighbor table — the quadratic "
+                    "object the matrix-free path exists to avoid; use "
+                    "topology_impl='dense' (k_max = N−1 leaves nothing "
+                    "for a degree-bounded route to win)"
+                )
+            if self.topology not in NEIGHBOR_TOPOLOGIES:
+                raise ValueError(
+                    f"topology_impl='neighbor' supports "
+                    f"{NEIGHBOR_TOPOLOGIES}; {self.topology!r} has no "
+                    "matrix-free constructor"
+                )
+            if self.backend != "jax":
+                raise ValueError(
+                    "topology_impl='neighbor' is a jax-backend capability "
+                    "(gather-form mixing); the numpy/cpp oracles run the "
+                    "dense matrix form — use topology_impl='dense'"
+                )
+            if self.mixing_impl not in ("auto", "gather", "stencil"):
+                raise ValueError(
+                    f"topology_impl='neighbor' never materializes the "
+                    f"[N, N] matrices that mixing_impl="
+                    f"{self.mixing_impl!r} consumes — use 'auto', "
+                    "'gather', or 'stencil'"
+                )
+            if self.attack != "none" or (
+                self.aggregation != "gossip" and self.robust_b > 0
+            ):
+                raise ValueError(
+                    "topology_impl='neighbor' does not compose with "
+                    "Byzantine injection / robust aggregation yet: the "
+                    "screening path composes through the dense "
+                    "realized_adjacency — run defense studies on "
+                    "topology_impl='dense'"
+                )
+            if self.edge_drop_prob > 0.0:
+                raise ValueError(
+                    "topology_impl='neighbor' supports the node-process "
+                    "fault modes (participation_rate, straggler_prob, "
+                    "mttf/mttr churn); per-edge drop processes "
+                    "(edge_drop_prob/burst_len) need the dense edge "
+                    "machinery — use topology_impl='dense'"
+                )
+            if self.gossip_schedule != "synchronous":
+                raise ValueError(
+                    "topology_impl='neighbor' requires "
+                    "gossip_schedule='synchronous' (matching schedules "
+                    "sample partners from the dense adjacency)"
+                )
+            if self.tp_degree > 1:
+                raise ValueError(
+                    "topology_impl='neighbor' does not compose with "
+                    "tp_degree > 1 (the TP path pins its own ring "
+                    "stencil over a device mesh)"
+                )
         if self.gossip_schedule not in ("synchronous", "one_peer",
                                         "round_robin"):
             raise ValueError(
@@ -701,6 +894,36 @@ class ExperimentConfig:
         pinned (>= 0), else ``seed``."""
         return self.data_seed if self.data_seed >= 0 else self.seed
 
+    def resolved_topology_impl(self) -> str:
+        """Resolve topology_impl='auto' (docs/PERF.md §14).
+
+        The neighbor-table-native (matrix-free) representation activates
+        automatically on the jax backend above ``MATRIX_FREE_AUTO_N``
+        workers for the topologies that have a matrix-free constructor,
+        provided no dense-only feature is requested — exactly the
+        conditions an explicit ``topology_impl='neighbor'`` validates
+        loudly. Below the threshold (or off the jax backend, or with a
+        dense-only feature in play) 'auto' keeps the dense form: at small
+        N the [N, N] matrices are cheap and every measured fast path
+        (stencil mixing, the fused robust kernels, dense fault machinery)
+        assumes them.
+        """
+        if self.topology_impl != "auto":
+            return self.topology_impl
+        dense_only_feature = (
+            self.backend != "jax"
+            or self.topology not in NEIGHBOR_TOPOLOGIES
+            or self.mixing_impl not in ("auto", "gather", "stencil")
+            or self.attack != "none"
+            or (self.aggregation != "gossip" and self.robust_b > 0)
+            or self.edge_drop_prob > 0.0
+            or self.gossip_schedule != "synchronous"
+            or self.tp_degree > 1
+        )
+        if not dense_only_feature and self.n_workers >= MATRIX_FREE_AUTO_N:
+            return "neighbor"
+        return "dense"
+
     def structural_dict(self) -> dict[str, Any]:
         """The canonical view of everything that changes the TRACED program.
 
@@ -724,6 +947,19 @@ class ExperimentConfig:
         and data shapes), and the request coalescer groups pending requests
         whose structural hash AND dataset agree into one ``run_batch``
         cohort.
+
+        The federated fields are STRUCTURAL, deliberately (tested in
+        tests/test_federated.py): ``local_steps`` changes the traced scan
+        body (τ unrolled/fori local descents), ``participation_rate``
+        both gates the fault machinery in or out AND bakes a different
+        presampled participation timeline shape decision, and
+        ``topology_impl`` selects between the dense-matrix and
+        gather-table programs. All three therefore stay in the dict
+        verbatim (``topology_impl`` as its RESOLVED value, so
+        'auto'-at-large-N and an explicit 'neighbor' of the same program
+        share a cohort) — two requests differing in any of them MISS each
+        other's cached executables rather than silently colliding into
+        one cohort.
         """
         d = self.to_dict()
         d["seed"] = None
@@ -735,6 +971,7 @@ class ExperimentConfig:
             if self.topology in RANDOM_TOPOLOGIES
             else None
         )
+        d["topology_impl"] = self.resolved_topology_impl()
         d["edge_faults_traced"] = self.edge_drop_prob > 0.0
         d["clip_tau_fixed"] = self.clip_tau > 0.0
         return d

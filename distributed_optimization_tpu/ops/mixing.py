@@ -47,7 +47,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_optimization_tpu.parallel.topology import Topology
+from distributed_optimization_tpu.config import MATRIX_FREE_AUTO_N
+from distributed_optimization_tpu.parallel.topology import (
+    NEIGHBOR_TABLE_MAX_CELLS,
+    Topology,
+    gather_mixing_weights,
+    neighbor_tables_for,
+)
 
 MixFn = Callable[[jax.Array], jax.Array]
 
@@ -89,16 +95,79 @@ def make_mixing_op(topo: Topology, impl: str = "auto", dtype=jnp.float32) -> Mix
     ``parallel/collectives.py`` because they need a Mesh.
     """
     if impl == "auto":
-        impl = "stencil" if _supports_stencil(topo) else "dense"
+        if _supports_stencil(topo):
+            # Stencils are already matrix-free (rolls/means of the whole
+            # block) and the measured winner where they apply.
+            impl = "stencil"
+        elif topo.is_matrix_free:
+            impl = "gather"
+        elif not topo.directed and topo.n >= MATRIX_FREE_AUTO_N:
+            # The k_max-bounded gather route (docs/PERF.md §14): default
+            # for matrix-backed irregular graphs above the measured
+            # threshold — the [N, N] contraction's O(N²·d) work and the
+            # matrix itself stop fitting where docs/perf/federated.json's
+            # scale cells take over from sparse_mixing.json's. Gate on
+            # the SAME degree bound build_neighbor_topology enforces:
+            # gather's [N, k_max, d] transient beats dense only while
+            # k_max ≪ N, so high-degree graphs (star, dense ER) keep the
+            # dense contraction instead of allocating a near-quadratic
+            # gather inside the scan.
+            k_max = int(np.asarray(topo.degrees).max())
+            degree_bounded = (
+                k_max + 1 < topo.n
+                and max(k_max, 1) * topo.n <= NEIGHBOR_TABLE_MAX_CELLS
+            )
+            impl = "gather" if degree_bounded else "dense"
+        else:
+            impl = "dense"
     if impl == "shard_map":
         raise ValueError(
             "shard_map mixing ops need a Mesh; build them via "
             "distributed_optimization_tpu.parallel.collectives instead"
         )
-    if impl not in ("dense", "stencil", "pallas", "sparse"):
+    if impl not in ("dense", "stencil", "pallas", "sparse", "gather"):
         raise ValueError(f"Unknown mixing impl: {impl!r}")
     if impl == "stencil" and not _supports_stencil(topo):
         raise ValueError(f"stencil mixing unsupported for {topo.name} (n={topo.n})")
+    if topo.is_matrix_free and impl not in ("stencil", "gather"):
+        raise ValueError(
+            f"mixing_impl={impl!r} consumes the dense [N, N] matrices a "
+            f"matrix-free topology ({topo.name}, n={topo.n}) never "
+            "materializes — use 'gather' (or 'stencil' where the graph "
+            "embeds as shifts)"
+        )
+
+    if impl == "gather":
+        if topo.directed:
+            raise ValueError(
+                "gather mixing is undirected-only (MH weights per slot); "
+                f"directed topology {topo.name!r} has no gather form"
+            )
+        nbr_idx_np, nbr_mask_np = neighbor_tables_for(topo)
+        w_nbr_np, w_self_np = gather_mixing_weights(
+            nbr_idx_np, nbr_mask_np, topo.degrees
+        )
+        nbr = jnp.asarray(nbr_idx_np, dtype=jnp.int32)
+        mask = jnp.asarray(nbr_mask_np, dtype=dtype)
+        w_nbr = jnp.asarray(w_nbr_np, dtype=dtype)
+        w_self = jnp.asarray(w_self_np, dtype=dtype)
+
+        def _bshape(x: jax.Array):
+            return (x.shape[0], nbr.shape[1]) + (1,) * (x.ndim - 1)
+
+        def apply(x: jax.Array) -> jax.Array:
+            gathered = x[nbr]  # [N, k_max, ...]
+            out = w_self.reshape((-1,) + (1,) * (x.ndim - 1)) * x + jnp.sum(
+                w_nbr.reshape(_bshape(x)) * gathered, axis=1
+            )
+            return out.astype(x.dtype)
+
+        def neighbor_sum(x: jax.Array) -> jax.Array:
+            return jnp.sum(
+                mask.reshape(_bshape(x)) * x[nbr], axis=1
+            ).astype(x.dtype)
+
+        return MixingOp(topo.name, "gather", apply, neighbor_sum)
 
     if impl == "pallas":
         # Hand-fused VMEM kernels (ops/pallas_kernels.py). Ring and

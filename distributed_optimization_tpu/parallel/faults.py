@@ -179,6 +179,11 @@ class FaultyMixing:
     # nodes that are not rejoining — or have no realized neighbors — pass
     # through untouched). None unless rejoin == 'neighbor_restart'.
     rejoin_restart: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None
+    # Per-round partial participation (client sampling, docs/PERF.md §14)
+    # is active: ``active(t)`` composes the presampled participation mask
+    # into the node-availability row, and the backend must freeze
+    # sampled-out nodes' state exactly like stragglers.
+    participation_active: bool = False
     # The host-side precomputed timeline backing this mixing (None on the
     # memoryless on-the-fly path) — exposed for diagnostics
     # (``node_downtime``, ``windowed_connectivity``) and tests.
@@ -206,6 +211,13 @@ class FaultTimeline:
     edge_up: Optional[np.ndarray] = None     # [horizon, E] bool
     node_up: Optional[np.ndarray] = None     # [horizon, N] bool
     rejoin: Optional[np.ndarray] = None      # [horizon, N] bool
+    # Per-round participation mask (client sampling, iid per (round,
+    # node) at rate ``participation_rate`` from its own key stream;
+    # docs/PERF.md §14). Composes with ``node_up`` by AND: a round's
+    # realized availability is churn-up AND sampled-in. Sampling is NOT
+    # an outage — no rejoin events — so ``rejoin`` stays a pure
+    # crash-recovery record.
+    part_up: Optional[np.ndarray] = None     # [horizon, N] bool
 
 
 def sample_surviving_adjacency(key, adjacency: jax.Array, drop_prob: float):
@@ -350,7 +362,17 @@ def iid_equivalent_churn(straggler_prob: float) -> tuple[float, float]:
 def _edge_list(topo: Topology) -> np.ndarray:
     """[E, 2] int32 edge list of the base topology: one row per undirected
     edge (i < j — the triu entry whose draw both endpoints share in the iid
-    sampler), or per one-way link (i, j) for directed graphs."""
+    sampler), or per one-way link (i, j) for directed graphs.
+
+    Matrix-free topologies enumerate the same i < j rows from the
+    neighbor table without touching a dense [N, N] array (used by the
+    connectivity diagnostics; per-edge fault PROCESSES stay dense-only).
+    """
+    if topo.is_matrix_free:
+        rows, slots = np.nonzero(topo.nbr_mask)
+        js = topo.nbr_idx[rows, slots]
+        keep = rows < js  # each undirected edge once, i < j
+        return np.stack([rows[keep], js[keep]], axis=1).astype(np.int32)
     A = np.asarray(topo.adjacency)
     src = np.triu(A, 1) if not topo.directed else A
     ei, ej = np.nonzero(src)
@@ -367,6 +389,7 @@ def build_fault_timeline(
     straggler_prob: float = 0.0,
     mttf: float = 0.0,
     mttr: float = 0.0,
+    participation_rate: float = 1.0,
 ) -> FaultTimeline:
     """Unroll the per-edge / per-node fault chains into host arrays.
 
@@ -402,7 +425,11 @@ def build_fault_timeline(
             "crash-recovery churn replaces iid stragglers; set one of "
             "(mttf, mttr) / straggler_prob, not both"
         )
-    n = topo.adjacency.shape[0]
+    if not 0.0 < participation_rate <= 1.0:
+        raise ValueError(
+            f"participation_rate must be in (0, 1], got {participation_rate}"
+        )
+    n = topo.n
     fault_key = jax.random.fold_in(jax.random.key(seed), 0x0FA17)
     node_key = jax.random.fold_in(jax.random.key(seed), 0x57A66)
     ts = jnp.arange(horizon, dtype=jnp.int32)
@@ -466,6 +493,25 @@ def build_fault_timeline(
         )
         rejoin = node_up & ~prev_up
 
+    part_up = None
+    if participation_rate < 1.0:
+        # Client sampling (docs/PERF.md §14): iid per (round, node) at the
+        # configured rate, from its OWN counter-based stream — distinct
+        # from the churn/straggler chain, so participation composes with
+        # (never perturbs) every other fault realization. Survival
+        # convention matches the node chain: in iff u >= 1 − rate.
+        part_key = jax.random.fold_in(jax.random.key(seed), 0x9AC70)
+        p_out = np.float32(1.0 - participation_rate)
+
+        def part_step(_, t):
+            u = jax.random.uniform(
+                jax.random.fold_in(part_key, t), (n,), dtype=jnp.float32
+            )
+            return None, u >= p_out
+
+        _, pups = jax.lax.scan(part_step, None, ts)
+        part_up = np.asarray(pups)
+
     return FaultTimeline(
         horizon=horizon,
         directed=topo.directed,
@@ -473,6 +519,7 @@ def build_fault_timeline(
         edge_up=edge_up,
         node_up=node_up,
         rejoin=rejoin,
+        part_up=part_up,
     )
 
 
@@ -530,6 +577,13 @@ def _realized_edge_alive(
             timeline.node_up[:, edges[:, 0]]
             & timeline.node_up[:, edges[:, 1]]
         )
+    if timeline.part_up is not None:
+        # A sampled-out client exchanges nothing: its incident edges are
+        # not realized that round, exactly like a down node's.
+        alive &= (
+            timeline.part_up[:, edges[:, 0]]
+            & timeline.part_up[:, edges[:, 1]]
+        )
     return alive, edges
 
 
@@ -568,7 +622,7 @@ def windowed_connectivity(
     a prefix-count sliding union per candidate.
     """
     alive, edges = _realized_edge_alive(timeline, topo)
-    n = topo.adjacency.shape[0]
+    n = topo.n
     T = timeline.horizon
     # Prefix counts: window [s, s+B) contains edge e iff counts differ.
     csum = np.concatenate(
@@ -617,6 +671,7 @@ def stack_fault_timelines(timelines: list[FaultTimeline]) -> FaultTimeline:
             or t.directed != t0.directed
             or (t.edge_up is None) != (t0.edge_up is None)
             or (t.node_up is None) != (t0.node_up is None)
+            or (t.part_up is None) != (t0.part_up is None)
         ):
             raise ValueError(
                 "timelines disagree in structure (horizon / fault modes); "
@@ -634,6 +689,7 @@ def stack_fault_timelines(timelines: list[FaultTimeline]) -> FaultTimeline:
         edge_up=_stack("edge_up"),
         node_up=_stack("node_up"),
         rejoin=_stack("rejoin"),
+        part_up=_stack("part_up"),
     )
 
 
@@ -650,6 +706,7 @@ def make_faulty_mixing(
     horizon: Optional[int] = None,
     keys: Optional[tuple] = None,
     timeline: Optional[FaultTimeline] = None,
+    participation_rate: float = 1.0,
 ) -> FaultyMixing:
     """Build time-varying mixing operators for a base topology.
 
@@ -707,13 +764,31 @@ def make_faulty_mixing(
             "policies act on the realized neighborhood, which a one-peer "
             "matching (at most one partner per round) cannot supply"
         )
-    use_timeline = burst_len >= 1.0 or churn_active or timeline is not None
+    if not 0.0 < participation_rate <= 1.0:
+        raise ValueError(
+            f"participation_rate must be in (0, 1], got {participation_rate}"
+        )
+    participation_active = participation_rate < 1.0
+    if participation_active and one_peer:
+        raise ValueError(
+            "participation sampling requires the synchronous schedule: the "
+            "sampled subgraph reweights the whole realized neighborhood, "
+            "which a one-peer matching cannot supply"
+        )
+    use_timeline = (
+        burst_len >= 1.0 or churn_active or participation_active
+        or timeline is not None
+        # Matrix-free node faults always route through the precomputed
+        # timeline (iid stragglers' chains are bitwise the on-the-fly
+        # draws, so nothing changes semantically — one code path).
+        or (topo.is_matrix_free and strag_active)
+    )
     if use_timeline and timeline is None:
         if horizon is None:
             raise ValueError(
-                "persistent fault processes (burst_len >= 1 or mttf/mttr) "
-                "precompute a [horizon]-indexed timeline; pass "
-                "horizon=n_iterations"
+                "persistent fault processes (burst_len >= 1, mttf/mttr, or "
+                "participation_rate < 1) precompute a [horizon]-indexed "
+                "timeline; pass horizon=n_iterations"
             )
         timeline = build_fault_timeline(
             topo, horizon, seed,
@@ -721,6 +796,26 @@ def make_faulty_mixing(
             burst_len=burst_len if burst_len >= 1.0 else 1.0,
             straggler_prob=0.0 if churn_active else straggler_prob,
             mttf=mttf, mttr=mttr,
+            participation_rate=participation_rate,
+        )
+    if topo.is_matrix_free:
+        # Matrix-free (neighbor-table-native) route: node-process faults
+        # only — participation sampling, iid stragglers, crash-recovery
+        # churn — realized entirely in gather form over the static
+        # [N, k_max] table; per-edge drop processes and matching
+        # schedules need the dense machinery and are rejected upstream
+        # (config validation) and here.
+        if drop_active or one_peer or topo.directed:
+            raise ValueError(
+                "matrix-free topologies support node-process faults only "
+                "(participation_rate / straggler_prob / mttf+mttr); edge "
+                "drops and matching schedules need the dense adjacency — "
+                "use topology_impl='dense'"
+            )
+        return _make_gather_faulty_mixing(
+            topo, timeline, drop_prob=drop_prob,
+            straggler_prob=straggler_prob, churn_active=churn_active,
+            participation_active=participation_active, rejoin=rejoin,
         )
     base_A = jnp.asarray(topo.adjacency, dtype=jnp.float32)
     # Distinct streams from batch sampling: fold tags into the seed key
@@ -736,6 +831,10 @@ def make_faulty_mixing(
             jnp.asarray(timeline.node_up)
             if timeline.node_up is not None else None
         )
+        part_up_dev = (
+            jnp.asarray(timeline.part_up)
+            if timeline.part_up is not None else None
+        )
         edge_up_dev = (
             jnp.asarray(timeline.edge_up)
             if timeline.edge_up is not None else None
@@ -743,11 +842,19 @@ def make_faulty_mixing(
         if edge_up_dev is not None:
             ei = jnp.asarray(timeline.edge_index[:, 0], dtype=jnp.int32)
             ej = jnp.asarray(timeline.edge_index[:, 1], dtype=jnp.int32)
+        node_masked = node_up_dev is not None or part_up_dev is not None
 
         def active(t) -> jax.Array:
-            if node_up_dev is None:
+            # Realized availability: churn/straggler-up AND sampled-in
+            # (participation). Either alone is the mask verbatim.
+            if not node_masked:
                 return jnp.ones(base_A.shape[0], dtype=jnp.float32)
-            return node_up_dev[t].astype(jnp.float32)
+            if node_up_dev is None:
+                return part_up_dev[t].astype(jnp.float32)
+            m = node_up_dev[t].astype(jnp.float32)
+            if part_up_dev is not None:
+                m = m * part_up_dev[t].astype(jnp.float32)
+            return m
 
         def realized_adjacency(t) -> jax.Array:
             if edge_up_dev is not None:
@@ -756,7 +863,7 @@ def make_faulty_mixing(
                 A_t = half if topo.directed else half + half.T
             else:
                 A_t = base_A
-            if node_up_dev is not None:
+            if node_masked:
                 m = active(t)
                 A_t = A_t * m[:, None] * m[None, :]  # down: exchanges nothing
             return A_t
@@ -819,7 +926,7 @@ def make_faulty_mixing(
                     out = out * edge_up_gather[t].astype(jnp.float32)[
                         slot_dev
                     ]
-                if timeline.node_up is not None:
+                if timeline.node_up is not None or timeline.part_up is not None:
                     m = active(t)
                     out = out * m[:, None] * m[nbr_dev]
                 return out
@@ -926,5 +1033,130 @@ def make_faulty_mixing(
         churn_active=churn_active,
         rejoin=rejoin,
         rejoin_restart=rejoin_restart,
+        participation_active=participation_active,
+        timeline=timeline,
+    )
+
+
+def _make_gather_faulty_mixing(
+    topo: Topology,
+    timeline: FaultTimeline,
+    *,
+    drop_prob: float,
+    straggler_prob: float,
+    churn_active: bool,
+    participation_active: bool,
+    rejoin: str,
+) -> FaultyMixing:
+    """Node-process faults over a matrix-free (neighbor-table) topology.
+
+    The realized graph at round t is the static table masked by the
+    composed node-availability row m_t (churn/straggler-up AND
+    sampled-in): ``live_t[i, s] = mask[i, s] · m_t[i] · m_t[nbr[i, s]]``.
+    Realized MH weights come straight from the live slots —
+    ``w = live / (1 + max(deg_i, deg_{nbr}))`` with the row remainder on
+    the diagonal, the identical per-entry formula the dense
+    ``metropolis_hastings_weights`` computes on the realized adjacency
+    (a fully-masked row degenerates to identity the same way) — so the
+    whole time-varying gossip round stays O(N·k_max·d) with no [N, N]
+    object anywhere. Same float32 mask/weight convention as the dense
+    path; only the mixed model values are cast back to the input dtype.
+    """
+    n = topo.n
+    nbr_dev = jnp.asarray(topo.nbr_idx, dtype=jnp.int32)
+    mask_dev = jnp.asarray(topo.nbr_mask, dtype=jnp.float32)
+    node_up_dev = (
+        jnp.asarray(timeline.node_up)
+        if timeline is not None and timeline.node_up is not None else None
+    )
+    part_up_dev = (
+        jnp.asarray(timeline.part_up)
+        if timeline is not None and timeline.part_up is not None else None
+    )
+
+    def active(t) -> jax.Array:
+        if node_up_dev is None and part_up_dev is None:
+            return jnp.ones(n, dtype=jnp.float32)
+        if node_up_dev is None:
+            return part_up_dev[t].astype(jnp.float32)
+        m = node_up_dev[t].astype(jnp.float32)
+        if part_up_dev is not None:
+            m = m * part_up_dev[t].astype(jnp.float32)
+        return m
+
+    def live(t) -> jax.Array:
+        m = active(t)
+        return mask_dev * m[:, None] * m[nbr_dev]
+
+    def _wshape(x: jax.Array):
+        return (n, nbr_dev.shape[1]) + (1,) * (x.ndim - 1)
+
+    def mix(t, x):
+        acc = jnp.promote_types(jnp.float32, x.dtype)
+        lv = live(t).astype(acc)
+        deg = jnp.sum(lv, axis=1)
+        w = lv / (1.0 + jnp.maximum(deg[:, None], deg[nbr_dev]))
+        w_self = 1.0 - jnp.sum(w, axis=1)
+        xa = x.astype(acc)
+        out = w_self.reshape((-1,) + (1,) * (x.ndim - 1)) * xa + jnp.sum(
+            w.reshape(_wshape(x)) * xa[nbr_dev], axis=1
+        )
+        return out.astype(x.dtype)
+
+    def neighbor_sum(t, x):
+        acc = jnp.promote_types(jnp.float32, x.dtype)
+        lv = live(t).astype(acc)
+        return jnp.sum(
+            lv.reshape(_wshape(x)) * x.astype(acc)[nbr_dev], axis=1
+        ).astype(x.dtype)
+
+    def realized_degree_sum(t):
+        return jnp.sum(live(t))
+
+    rejoin_restart = None
+    if churn_active and rejoin == "neighbor_restart":
+        rejoin_dev = jnp.asarray(timeline.rejoin)
+
+        def rejoin_restart(t, x) -> jax.Array:
+            # Gather twin of the dense warm restart: a rejoining node's
+            # model row becomes its realized-neighborhood average;
+            # isolated rejoiners keep their stale state.
+            acc = jnp.promote_types(jnp.float32, x.dtype)
+            lv = live(t).astype(acc)
+            deg = jnp.sum(lv, axis=1)
+            nbr_avg = jnp.sum(
+                lv[:, :, None] * x.astype(acc)[nbr_dev], axis=1
+            ) / jnp.maximum(deg, 1.0)[:, None]
+            take = rejoin_dev[t] & (deg > 0)
+            return jnp.where(
+                take[:, None], nbr_avg, x.astype(acc)
+            ).astype(x.dtype)
+
+    def make_neighbor_liveness(nbr_idx: np.ndarray, nbr_mask: np.ndarray):
+        # Same contract as the dense path's: live(t) over the CALLER's
+        # tables (which, for a matrix-free topology, are the topology's
+        # own — there is exactly one table). Node composition only.
+        caller_nbr = jnp.asarray(nbr_idx, dtype=jnp.int32)
+        caller_mask = jnp.asarray(nbr_mask, dtype=jnp.float32)
+
+        def live_fn(t) -> jax.Array:
+            m = active(t)
+            return caller_mask * m[:, None] * m[caller_nbr]
+
+        return live_fn
+
+    return FaultyMixing(
+        mix=mix,
+        neighbor_sum=neighbor_sum,
+        realized_degree_sum=realized_degree_sum,
+        active=active,
+        drop_prob=drop_prob if isinstance(drop_prob, (int, float)) else 0.0,
+        straggler_prob=straggler_prob,
+        realized_adjacency=None,
+        make_neighbor_liveness=make_neighbor_liveness,
+        churn_active=churn_active,
+        rejoin=rejoin,
+        rejoin_restart=rejoin_restart,
+        participation_active=participation_active,
         timeline=timeline,
     )

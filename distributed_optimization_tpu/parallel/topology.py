@@ -27,6 +27,21 @@ the undirected ring's boundary traffic.
 This module is host-side (numpy): topologies are built once per run, outside
 ``jit``. The compiled mixing operators that consume them live in
 ``ops/mixing.py`` and ``parallel/collectives.py``.
+
+Round 8 adds the MATRIX-FREE representation (``build_topology(...,
+impl='neighbor')``): ring/torus/chain/Erdős–Rényi built directly as a
+static padded ``[N, k_max]`` neighbor table — the dense ``[N, N]``
+adjacency and mixing matrix are never materialized (``adjacency`` /
+``mixing_matrix`` are None; at N = 10k the dense float64 pair alone is
+~1.6 GB, the cap docs/perf/sparse_mixing.json ran into around N≈4k).
+Everything downstream that needs the graph reads the table: gather-form
+MH mixing (``gather_mixing_weights`` + ``ops/mixing.py`` impl='gather',
+O(N·k_max·d) per round), node-process fault composition
+(``parallel/faults.py``), and the spectral gap via closed forms or
+matrix-free power iteration. The ER constructor consumes the numpy
+Generator stream row-by-row in exactly the order the dense sampler's one
+``random((n, n))`` call does, so both representations of G(n, p, seed)
+realize the IDENTICAL graph.
 """
 
 from __future__ import annotations
@@ -36,6 +51,17 @@ import math
 from typing import Optional
 
 import numpy as np
+
+# Mirrors config.NEIGHBOR_TOPOLOGIES / config.MATRIX_FREE_AUTO_N (config
+# stays import-light; the single source of the AUTO policy is config.py —
+# this module only needs to know which names have a constructor).
+MATRIX_FREE_TOPOLOGIES = ("ring", "grid", "chain", "erdos_renyi")
+
+# Power-iteration budget for the matrix-free spectral-gap estimate: the
+# norm ratio converges to ρ geometrically in the (|λ3|/|λ2|) ratio, and
+# 500 applications at O(N·k_max) each is still ~10^7 flops at N = 10k —
+# cheaper than one dense [N, N] eigendecomposition at N = 1k.
+_POWER_ITERS = 500
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,17 +73,34 @@ class Topology:
     directed graphs carry a column-stochastic uniform-out-weight matrix
     (each node splits its mass equally over its out-neighbors and itself),
     the push-sum setting. ``adjacency[i, j] = 1`` iff j sends to i.
+
+    MATRIX-FREE topologies (``impl='neighbor'``) set ``adjacency`` and
+    ``mixing_matrix`` to None and carry the padded neighbor table instead:
+    ``nbr_idx [N, k_max]`` int32 (row i = i's neighbors ascending, padded
+    slots pointing at i) and ``nbr_mask [N, k_max]`` bool — exactly the
+    layout ``neighbor_table`` derives from a dense adjacency, so dense and
+    matrix-free builds of the same graph produce bit-identical tables.
     """
 
     name: str
     n: int
-    adjacency: np.ndarray  # [N, N] 0/1, zero diagonal; row i = i's in-edges
+    # [N, N] 0/1, zero diagonal; row i = i's in-edges. None when the
+    # topology is matrix-free (neighbor-table-native).
+    adjacency: Optional[np.ndarray]
     # Out-degrees (== in-degrees for undirected graphs): how many neighbors
     # each node TRANSMITS to per gossip round — the comms-accounting side.
     degrees: np.ndarray  # [N]
-    mixing_matrix: np.ndarray  # [N, N]; MH (undirected) or column-stochastic
+    # [N, N]; MH (undirected) or column-stochastic. None when matrix-free.
+    mixing_matrix: Optional[np.ndarray]
     grid_shape: Optional[tuple[int, int]] = None  # set for 'grid'
     directed: bool = False
+    # Matrix-free neighbor table (None on the dense representation).
+    nbr_idx: Optional[np.ndarray] = None   # [N, k_max] int32
+    nbr_mask: Optional[np.ndarray] = None  # [N, k_max] bool
+
+    @property
+    def is_matrix_free(self) -> bool:
+        return self.adjacency is None
 
     @property
     def spectral_gap(self) -> float:
@@ -69,14 +112,53 @@ class Topology:
         spectrum; ρ is the second-largest eigenvalue MODULUS (the
         ergodicity coefficient of the column-stochastic chain — self-loops
         make it primitive, so ρ < 1 for strongly connected graphs).
+
+        Matrix-free topologies never materialize W: ring and torus use
+        their closed forms (exact — uniform MH weights by symmetry);
+        chain/ER estimate ρ by power iteration on the mean-deflated
+        gather-form operator v ↦ W v − v̄ (O(N·k_max) per application,
+        deterministic start vector), accurate to the iteration budget's
+        geometric tail — a diagnostic, like the dense eigensolve.
         """
         if self.n < 2:
             return 1.0
+        if self.is_matrix_free:
+            if self.name == "ring" and self.n >= 3:
+                return ring_spectral_gap_closed_form(self.n)
+            if (
+                self.name == "grid"
+                and self.grid_shape is not None
+                and self.grid_shape[0] == self.grid_shape[1]
+                and min(self.grid_shape) >= 3
+            ):
+                return torus_spectral_gap_closed_form(self.grid_shape[0])
+            return self._power_iteration_gap()
         if self.directed:
             eigs = np.sort(np.abs(np.linalg.eigvals(self.mixing_matrix)))
         else:
             eigs = np.sort(np.abs(np.linalg.eigvalsh(self.mixing_matrix)))
         return float(1.0 - eigs[-2])
+
+    def _power_iteration_gap(self) -> float:
+        """ρ ≈ lim ‖B^k v‖ / ‖B^{k−1} v‖ for B = W − (1/n)𝟙𝟙ᵀ (symmetric,
+        so the normalized-iterate norm converges to the largest
+        |eigenvalue| of the deflated operator — i.e. ρ — even under
+        eigenvalue multiplicity, the ring's generic case)."""
+        w_nbr, w_self = gather_mixing_weights(
+            self.nbr_idx, self.nbr_mask, self.degrees
+        )
+        v = np.random.default_rng(0).standard_normal(self.n)
+        v -= v.mean()
+        v /= np.linalg.norm(v)
+        rho = 0.0
+        for _ in range(_POWER_ITERS):
+            v = w_self * v + np.sum(w_nbr * v[self.nbr_idx], axis=1)
+            v -= v.mean()
+            rho = np.linalg.norm(v)
+            if rho < 1e-300:  # degenerate: W is exact averaging
+                return 1.0
+            v /= rho
+        return float(1.0 - rho)
 
     @property
     def floats_per_iteration(self) -> float:
@@ -96,7 +178,41 @@ class Topology:
         Directed graphs swap the row-sum + symmetry invariants for the
         column-sum one: column-stochasticity is exactly mass conservation,
         the property push-sum's debiasing relies on (Σ_i (Ax)_i = Σ_j x_j).
+
+        Matrix-free topologies validate the TABLE invariants instead:
+        in-range indices, padded slots self-pointing, degrees matching the
+        mask, and symmetry (every (i → j) slot has a (j → i) twin) — the
+        property that makes gather-form MH mixing doubly stochastic.
         """
+        if self.is_matrix_free:
+            idx, mask = self.nbr_idx, self.nbr_mask
+            if idx is None or mask is None or idx.shape != mask.shape:
+                raise AssertionError(
+                    f"matrix-free topology needs matching nbr_idx/nbr_mask "
+                    f"tables ({self.name})"
+                )
+            if idx.min() < 0 or idx.max() >= self.n:
+                raise AssertionError(
+                    f"neighbor indices out of range ({self.name})"
+                )
+            if not np.all(idx[~mask] == np.nonzero(~mask)[0]):
+                raise AssertionError(
+                    f"padded neighbor slots must self-point ({self.name})"
+                )
+            if not np.array_equal(mask.sum(axis=1), self.degrees):
+                raise AssertionError(
+                    f"degrees disagree with the neighbor mask ({self.name})"
+                )
+            edges = {
+                (int(i), int(j))
+                for i, row_mask in enumerate(mask)
+                for j in idx[i, row_mask]
+            }
+            if any((j, i) not in edges for i, j in edges):
+                raise AssertionError(
+                    f"neighbor table must be symmetric ({self.name})"
+                )
+            return
         W = self.mixing_matrix
         if np.any(W < -1e-12):
             raise AssertionError(f"Mixing matrix must be nonnegative ({self.name})")
@@ -284,6 +400,201 @@ def incident_edge_slots(
     return slots
 
 
+def _pad_neighbor_lists(nbrs: list[np.ndarray], n: int):
+    """Pack per-node ascending neighbor lists into the padded table
+    (identical layout/convention to ``neighbor_table``: padded slots point
+    at the node itself, mask False)."""
+    k_max = max((len(v) for v in nbrs), default=0)
+    k_max = max(k_max, 1)
+    nbr_idx = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, k_max))
+    nbr_mask = np.zeros((n, k_max), dtype=bool)
+    for i, v in enumerate(nbrs):
+        nbr_idx[i, : len(v)] = np.sort(v).astype(np.int32)
+        nbr_mask[i, : len(v)] = True
+    return nbr_idx, nbr_mask
+
+
+def _ring_neighbor_lists(n: int) -> list[np.ndarray]:
+    if n <= 1:
+        return [np.empty(0, dtype=np.int64) for _ in range(n)]
+    if n == 2:
+        return [np.array([1]), np.array([0])]
+    return [
+        np.unique(np.array([(i - 1) % n, (i + 1) % n]))
+        for i in range(n)
+    ]
+
+
+def _chain_neighbor_lists(n: int) -> list[np.ndarray]:
+    out = []
+    for i in range(n):
+        v = [j for j in (i - 1, i + 1) if 0 <= j < n]
+        out.append(np.asarray(v, dtype=np.int64))
+    return out
+
+
+def _torus_neighbor_lists(rows: int, cols: int) -> list[np.ndarray]:
+    """Same node indexing and neighbor set as ``_torus_adjacency`` (row-major
+    (r, c) ↦ r·cols + c; degenerate short axes collapse duplicates)."""
+    out = []
+    for r in range(rows):
+        for c in range(cols):
+            i = r * cols + c
+            js = {
+                (rr % rows) * cols + (cc % cols)
+                for rr, cc in ((r - 1, c), (r + 1, c), (r, c - 1), (r, c + 1))
+            }
+            js.discard(i)
+            out.append(np.asarray(sorted(js), dtype=np.int64))
+    return out
+
+
+def _erdos_renyi_neighbor_lists(
+    n: int, p: float, seed: int
+) -> list[np.ndarray]:
+    """Connected G(n, p) WITHOUT the [N, N] draw matrix.
+
+    Bit-identical to ``_erdos_renyi_adjacency``: numpy's Generator fills
+    ``random((n, n))`` row-major from one sequential stream, so drawing
+    ``random(n)`` per row walks the same values in the same order — the
+    same (seed, try) realizes the same graph in both representations
+    (pinned by tests/test_federated.py). Memory is O(n) per row plus the
+    O(E) adjacency lists; connectivity is union-find over the edges as
+    they are drawn.
+    """
+    rng = np.random.default_rng(seed)
+    for _ in range(1000):
+        nbrs: list[list[int]] = [[] for _ in range(n)]
+        parent = list(range(n))
+
+        def find(a: int) -> int:
+            while parent[a] != a:
+                parent[a] = parent[parent[a]]
+                a = parent[a]
+            return a
+
+        comps = n
+        for i in range(n):
+            row = rng.random(n)
+            for j in np.nonzero(row[i + 1:] < p)[0]:
+                j = int(i + 1 + j)
+                nbrs[i].append(j)
+                nbrs[j].append(i)
+                ri, rj = find(i), find(j)
+                if ri != rj:
+                    parent[ri] = rj
+                    comps -= 1
+        if comps == 1:
+            return [np.asarray(v, dtype=np.int64) for v in nbrs]
+    raise RuntimeError(f"Could not sample a connected G({n}, {p}) in 1000 tries")
+
+
+# Ceiling on the padded neighbor-table cell count (satellite guard): a
+# topology whose k_max approaches N has no degree-bounded structure to
+# exploit, and "matrix-free" would just reallocate the quadratic object
+# under a different name. fully_connected/star are rejected by name with
+# the specific message; this catches dense Erdős–Rényi draws.
+NEIGHBOR_TABLE_MAX_CELLS = 64_000_000
+
+
+def build_neighbor_topology(
+    name: str,
+    n: int,
+    *,
+    erdos_renyi_p: float = 0.4,
+    seed: int = 0,
+) -> Topology:
+    """Matrix-free constructor: the [N, k_max] neighbor table IS the graph.
+
+    Supports ``MATRIX_FREE_TOPOLOGIES`` (undirected, degree-bounded).
+    fully_connected and star are rejected loudly — k_max = N−1 makes the
+    padded table the very [N, N] allocation this path exists to avoid —
+    and any draw whose table would exceed ``NEIGHBOR_TABLE_MAX_CELLS``
+    (or whose k_max reaches N−1) routes the caller back to dense with the
+    reason.
+    """
+    if name in ("fully_connected", "star"):
+        raise ValueError(
+            f"topology {name!r} has k_max = N-1: its neighbor table IS the "
+            "dense [N, N] object the matrix-free path avoids — use the "
+            "dense representation (impl='dense')"
+        )
+    grid_shape: Optional[tuple[int, int]] = None
+    if name == "ring":
+        nbrs = _ring_neighbor_lists(n)
+    elif name == "chain":
+        nbrs = _chain_neighbor_lists(n)
+    elif name == "grid":
+        side = int(math.isqrt(n))
+        if side * side != n:
+            raise ValueError(f"grid topology requires a perfect square, got {n}")
+        nbrs = _torus_neighbor_lists(side, side)
+        grid_shape = (side, side)
+    elif name == "erdos_renyi":
+        nbrs = _erdos_renyi_neighbor_lists(n, erdos_renyi_p, seed)
+    else:
+        raise ValueError(
+            f"no matrix-free constructor for topology {name!r} "
+            f"(supported: {MATRIX_FREE_TOPOLOGIES})"
+        )
+    k_max = max((len(v) for v in nbrs), default=0)
+    if n > 2 and k_max >= n - 1:
+        raise ValueError(
+            f"realized max degree {k_max} at N={n} leaves no degree bound "
+            "to exploit — the neighbor table would match the dense "
+            "adjacency's footprint; use the dense representation"
+        )
+    if max(k_max, 1) * n > NEIGHBOR_TABLE_MAX_CELLS:
+        raise ValueError(
+            f"neighbor table would hold {max(k_max, 1) * n:,} cells "
+            f"(k_max={k_max}, N={n}) > NEIGHBOR_TABLE_MAX_CELLS "
+            f"({NEIGHBOR_TABLE_MAX_CELLS:,}) — this graph is too dense "
+            "for the degree-bounded path; use the dense representation "
+            "or a sparser graph"
+        )
+    nbr_idx, nbr_mask = _pad_neighbor_lists(nbrs, n)
+    topo = Topology(
+        name=name,
+        n=n,
+        adjacency=None,
+        degrees=nbr_mask.sum(axis=1).astype(np.float64),
+        mixing_matrix=None,
+        grid_shape=grid_shape,
+        nbr_idx=nbr_idx,
+        nbr_mask=nbr_mask,
+    )
+    topo.validate()
+    return topo
+
+
+def neighbor_tables_for(topo: Topology) -> tuple[np.ndarray, np.ndarray]:
+    """The (nbr_idx, nbr_mask) tables of any undirected topology: native
+    for matrix-free builds, derived via ``neighbor_table`` from the dense
+    adjacency otherwise (both produce the identical layout)."""
+    if topo.nbr_idx is not None:
+        return topo.nbr_idx, topo.nbr_mask
+    return neighbor_table(topo.adjacency)
+
+
+def gather_mixing_weights(
+    nbr_idx: np.ndarray, nbr_mask: np.ndarray, degrees: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Metropolis-Hastings weights in gather (per-slot) form.
+
+    Returns ``(w_nbr [N, k_max], w_self [N])`` float64 with
+    ``w_nbr[i, s] = 1/(1 + max(deg_i, deg_{nbr[i, s]}))`` on live slots
+    (0 on padding) and ``w_self = 1 − Σ_s w_nbr`` — elementwise the same
+    values as ``metropolis_hastings_weights`` read at (i, nbr[i, s]) and
+    (i, i), never materializing the [N, N] matrix. ``W x`` is then
+    ``w_self·x + Σ_s w_nbr[:, s]·x[nbr[:, s]]``: O(N·k_max·d).
+    """
+    deg = np.asarray(degrees, dtype=np.float64)
+    pair = np.maximum(deg[:, None], deg[nbr_idx])
+    w_nbr = np.where(nbr_mask, 1.0 / (1.0 + pair), 0.0)
+    w_self = 1.0 - w_nbr.sum(axis=1)
+    return w_nbr, w_self
+
+
 def metropolis_hastings_weights(adjacency: np.ndarray) -> np.ndarray:
     """Metropolis-Hastings mixing matrix from an adjacency matrix.
 
@@ -321,13 +632,26 @@ def build_topology(
     *,
     erdos_renyi_p: float = 0.4,
     seed: int = 0,
+    impl: str = "dense",
 ) -> Topology:
     """Build a named topology over ``n`` workers.
 
     Undirected names get MH mixing weights; directed names
     (``directed_ring``, ``directed_erdos_renyi``) get column-stochastic
     uniform-out weights (the push-sum setting).
+
+    ``impl``: 'dense' materializes the [N, N] adjacency + mixing matrix
+    (the historical representation); 'neighbor' builds the matrix-free
+    padded neighbor table instead (``build_neighbor_topology`` — the
+    federated-scale route, docs/PERF.md §14). Callers resolve 'auto'
+    policy via ``config.resolved_topology_impl()`` before calling.
     """
+    if impl == "neighbor":
+        return build_neighbor_topology(
+            name, n, erdos_renyi_p=erdos_renyi_p, seed=seed
+        )
+    if impl != "dense":
+        raise ValueError(f"Unknown topology impl: {impl!r}")
     if name in ("directed_ring", "directed_erdos_renyi"):
         adj = (
             _directed_ring_adjacency(n)

@@ -199,6 +199,15 @@ def _health_section(records) -> list[str]:
             parts.append(
                 f"B̂ {bhat if bhat is not None else '∞ (disconnected union)'}"
             )
+        part = h.get("participation")
+        if part is not None:
+            # Client sampling (docs/PERF.md §14): realized participation
+            # against the configured rate — a realized fraction far off
+            # target is the first sign the sampling mask isn't composing.
+            parts.append(
+                f"participation {part['realized_frac_mean']:.1%} "
+                f"(target {part['rate']:.0%})"
+            )
         if h.get("clip_frac_mean"):
             parts.append(f"screened msgs {h['clip_frac_mean']:.1%}")
         comms = h.get("comms")
@@ -214,6 +223,14 @@ def _health_section(records) -> list[str]:
             parts.append(
                 f"floats/iter {comms['floats_per_iteration_mean']:.4g}{tag}"
             )
+            if comms.get("local_steps"):
+                # τ gradient steps per exchanged round: the federated
+                # comms-reduction lever, quoted per gradient step.
+                parts.append(
+                    f"floats/grad-step "
+                    f"{comms['floats_per_gradient_step']:.4g} "
+                    f"(τ={comms['local_steps']})"
+                )
         if parts:
             lines.append(f"  {rec.label:<26}" + ", ".join(parts))
     return lines

@@ -272,7 +272,28 @@ def read_jsonl(path) -> list[RunTrace]:
 # --------------------------------------------------------------- run health
 
 
-def realized_bhat(config, max_cells: int = 2_000_000) -> Optional[dict]:
+def _config_topology(config):
+    """The run's communication graph, built once per health derivation
+    (None for centralized configs). ``health_summary`` threads one build
+    through both consumers — at the matrix-free scales the ER constructor
+    walks an O(N²) draw stream, so rebuilding per helper is real seconds
+    of redundant host work per request."""
+    from distributed_optimization_tpu.algorithms import get_algorithm
+    from distributed_optimization_tpu.parallel import build_topology
+
+    if not get_algorithm(config.algorithm).is_decentralized:
+        return None
+    return build_topology(
+        config.topology, config.n_workers,
+        erdos_renyi_p=config.erdos_renyi_p,
+        seed=config.resolved_topology_seed(),
+        impl=config.resolved_topology_impl(),
+    )
+
+
+def realized_bhat(
+    config, max_cells: int = 2_000_000, *, topo=None
+) -> Optional[dict]:
     """Realized windowed-connectivity B̂ of this config's fault process.
 
     Rebuilds the run's fault timeline host-side — bitwise the realization
@@ -287,7 +308,6 @@ def realized_bhat(config, max_cells: int = 2_000_000) -> Optional[dict]:
     the result.
     """
     from distributed_optimization_tpu.algorithms import get_algorithm
-    from distributed_optimization_tpu.parallel import build_topology
     from distributed_optimization_tpu.parallel.faults import (
         _edge_list,
         _union_connected,
@@ -302,17 +322,15 @@ def realized_bhat(config, max_cells: int = 2_000_000) -> Optional[dict]:
         # processes — the timeline rebuild below would not be the realized
         # graph sequence.
         return None
-    topo = build_topology(
-        config.topology, config.n_workers,
-        erdos_renyi_p=config.erdos_renyi_p,
-        seed=config.resolved_topology_seed(),
-    )
+    if topo is None:
+        topo = _config_topology(config)
     edges = _edge_list(topo)
     n_edges = max(len(edges), 1)
     faults_active = (
         config.edge_drop_prob > 0.0
         or config.straggler_prob > 0.0
         or config.mttf > 0.0
+        or config.participation_rate < 1.0
     )
     if not faults_active:
         connected = _union_connected(
@@ -329,6 +347,7 @@ def realized_bhat(config, max_cells: int = 2_000_000) -> Optional[dict]:
             0.0 if config.mttf > 0.0 else config.straggler_prob
         ),
         mttf=config.mttf, mttr=config.mttr,
+        participation_rate=config.participation_rate,
     )
     return {"bhat": windowed_connectivity(tl, topo),
             "horizon": horizon}
@@ -356,6 +375,7 @@ def health_summary(config, history, *, serving: Optional[dict] = None) -> dict:
     finite = obj[np.isfinite(obj)]
     h["final_gap"] = float(obj[-1]) if obj.size else None
     h["n_nonfinite_evals"] = int(obj.size - finite.size)
+    topo = _config_topology(config)  # one build serves every block below
     tr = history.trace
     if tr:
         gn = np.asarray(tr["grad_norm"], dtype=np.float64)
@@ -368,14 +388,26 @@ def health_summary(config, history, *, serving: Optional[dict] = None) -> dict:
         h["nonfinite_total"] = float(np.sum(tr["nonfinite"]))
         nodes = np.asarray(tr["nodes_up"], dtype=np.float64)
         h["min_nodes_up_frac"] = float(nodes.mean(axis=-1).min())
+        if config.participation_rate < 1.0:
+            # Realized participation per eval round (the satellite: the
+            # recorded series IS the nodes_up trace — availability under
+            # client sampling is churn-up AND sampled-in); the summary
+            # quotes its mean against the configured target rate.
+            h["participation"] = {
+                "rate": float(config.participation_rate),
+                "realized_frac_mean": float(nodes.mean()),
+            }
         h["clip_frac_mean"] = float(np.mean(tr["clip_frac"]))
         live = np.asarray(tr["live_edges"], dtype=np.float64)
-        nominal = _nominal_degree_sum(config)
+        nominal = (
+            float(np.asarray(topo.degrees).sum()) if topo is not None
+            else None
+        )
         h["realized_edge_frac"] = (
             float(live.mean() / nominal) if nominal else None
         )
     h["comms"] = comms_summary(config, history)
-    h["windowed_connectivity"] = realized_bhat(config)
+    h["windowed_connectivity"] = realized_bhat(config, topo=topo)
     return h
 
 
@@ -411,6 +443,12 @@ def comms_summary(config, history) -> Optional[dict]:
         # payload is the same as dsgd's; the per-iteration figure is 2×).
         "floats_per_iteration_mean": per_iter,
     }
+    if config.local_steps > 1:
+        # τ local descents per round at unchanged per-round comms — the
+        # federated communication-reduction lever (docs/PERF.md §14):
+        # floats per GRADIENT STEP is the per-round figure over τ.
+        out["local_steps"] = int(config.local_steps)
+        out["floats_per_gradient_step"] = per_iter / config.local_steps
     tr = history.trace
     if tr and "live_edges" in tr:
         live = np.asarray(tr["live_edges"], dtype=np.float64)
@@ -422,17 +460,8 @@ def comms_summary(config, history) -> Optional[dict]:
 
 
 def _nominal_degree_sum(config) -> Optional[float]:
-    from distributed_optimization_tpu.algorithms import get_algorithm
-    from distributed_optimization_tpu.parallel import build_topology
-
-    if not get_algorithm(config.algorithm).is_decentralized:
-        return None
-    topo = build_topology(
-        config.topology, config.n_workers,
-        erdos_renyi_p=config.erdos_renyi_p,
-        seed=config.resolved_topology_seed(),
-    )
-    return float(np.asarray(topo.adjacency).sum())
+    topo = _config_topology(config)
+    return float(np.asarray(topo.degrees).sum()) if topo is not None else None
 
 
 # ----------------------------------------------------------- bench sidecars

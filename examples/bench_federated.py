@@ -1,0 +1,360 @@
+"""Federated-scale execution evidence (ISSUE 8) -> docs/perf/federated.json.
+
+Three measured claims, each gated by an assertion so regressions fail the
+regen run loudly:
+
+1. **Local steps buy communication** — τ gradient steps per gossip round at
+   UNCHANGED per-round comms: floats-to-ε drops ≥ 2× for some τ > 1 cell
+   vs τ = 1 at a matched final-gap envelope (every τ > 1 cell ends at or
+   below the τ = 1 final gap). The cost model is trivial and exact here:
+   floats/round is constant in τ, so the reduction IS the rounds-to-ε
+   ratio.
+2. **Participation trades convergence for per-round cost** — client
+   sampling at rate q realizes ≈ q²·Σdeg·d floats/round (both endpoints
+   must be sampled in; measured against the analytic model per cell) with
+   monotone convergence degradation across ≥ 3 rates.
+3. **The matrix-free path lifts the worker axis to N ≥ 10k** — the
+   neighbor-table route completes (throughput + peak RSS recorded, each
+   cell in its own subprocess so peaks don't mask each other) where the
+   dense representation is skipped-by-arithmetic at N = 10k, with honest
+   per-cell ``matrix_free_loses`` flags where dense is measured faster on
+   this CPU container.
+
+CPU-container honesty: throughput numbers here are CPU numbers; the
+within-artifact comparisons (τ ratios, rate curves, dense-vs-neighbor
+flags) are the load-bearing content, same convention as the other benches.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+from concurrent import futures
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+EPS = 10.0
+OUT = REPO / "docs" / "perf" / "federated.json"
+
+BASE = dict(
+    n_workers=32, n_samples=3200, n_features=16, n_informative_features=10,
+    problem_type="quadratic", topology="ring", algorithm="dsgd",
+    local_batch_size=16, partition="shuffled", n_iterations=2000,
+    eval_every=20,
+)
+
+TAUS = (1, 2, 4, 8)
+RATES = (1.0, 0.5, 0.25)
+
+# (n, topology_impl) scale cells; every cell runs in its own subprocess so
+# per-cell peak RSS is honest. The graph is a sparse Erdős–Rényi draw at
+# mean degree ~12 (p = 12/N) — the irregular-graph case where the dense
+# route really is an [N, N] matmul per round and gather is the only
+# matrix-free mixing (ring/torus stencils are already matrix-free either
+# way). Dense at N = 10k is skipped by arithmetic: the [N, N] float64
+# adjacency+mixing pair alone is ~1.6 GB before a single iteration runs —
+# exactly the cap the matrix-free path removes.
+SCALE_N = (1024, 4096, 10_000)
+SCALE_MEAN_DEGREE = 12.0
+SCALE_T = 100
+DENSE_SKIP_N = 10_000
+
+
+def _problem(cfg):
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+    from distributed_optimization_tpu.utils.oracle import (
+        compute_reference_optimum,
+    )
+
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    return ds, f_opt
+
+
+def _run(cfg, ds, f_opt):
+    from distributed_optimization_tpu.backends import jax_backend
+
+    return jax_backend.run(cfg, ds, f_opt, use_mesh=False)
+
+
+def bench_local_steps():
+    import numpy as np
+
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.metrics import iterations_to_threshold
+
+    cfg0 = ExperimentConfig(**BASE)
+    ds, f_opt = _problem(cfg0)
+    cells = []
+    for tau in TAUS:
+        cfg = cfg0.replace(local_steps=tau)
+        r = _run(cfg, ds, f_opt)
+        floats_per_round = (
+            r.history.total_floats_transmitted / cfg.n_iterations
+        )
+        rounds = iterations_to_threshold(
+            r.history.objective, EPS, r.history.eval_iterations
+        )
+        cells.append({
+            "local_steps": tau,
+            "rounds_to_eps": rounds,
+            "grad_steps_to_eps": rounds * tau if rounds > 0 else -1,
+            "floats_to_eps": (
+                rounds * floats_per_round if rounds > 0 else None
+            ),
+            "floats_per_round": floats_per_round,
+            "final_gap": float(r.history.objective[-1]),
+        })
+        print(f"[local_steps] tau={tau}: rounds->eps={rounds}, "
+              f"final gap={cells[-1]['final_gap']:.4g}")
+    base_cell = cells[0]
+    assert base_cell["floats_to_eps"] is not None, (
+        "tau=1 baseline never reached eps; raise EPS or the horizon"
+    )
+    best = None
+    for c in cells[1:]:
+        # Matched final-gap envelope: a tau cell only counts if it ends at
+        # or below the tau=1 final gap (communication saved, accuracy not
+        # traded away).
+        if c["floats_to_eps"] is None:
+            continue
+        if c["final_gap"] > base_cell["final_gap"] * 1.05:
+            continue
+        ratio = base_cell["floats_to_eps"] / c["floats_to_eps"]
+        c["floats_reduction_vs_tau1"] = ratio
+        if best is None or ratio > best:
+            best = ratio
+    assert best is not None and best >= 2.0, (
+        f"no tau>1 cell achieved the >=2x floats-to-eps reduction at a "
+        f"matched final-gap envelope (best={best})"
+    )
+    print(f"[local_steps] best floats-to-eps reduction: {best:.1f}x")
+    return {
+        "config": cfg0.to_dict(),
+        "eps": EPS,
+        "cells": cells,
+        "best_floats_reduction": best,
+        "asserted_floor": 2.0,
+    }, cfg0
+
+
+def bench_participation():
+    import numpy as np
+
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.metrics import iterations_to_threshold
+
+    cfg0 = ExperimentConfig(**BASE)
+    ds, f_opt = _problem(cfg0)
+    d_payload = ds.n_features  # gossiped model dimension (d+1 bias column)
+    cells = []
+    for rate in RATES:
+        cfg = cfg0.replace(participation_rate=rate)
+        r = _run(cfg, ds, f_opt)
+        realized = r.history.total_floats_transmitted / cfg.n_iterations
+        # Cost model: an edge is realized iff BOTH endpoints are sampled
+        # in -> E[floats/round] = q^2 * sum(deg) * d.
+        analytic = rate * rate * 2.0 * cfg.n_workers * d_payload
+        obj = np.asarray(r.history.objective, dtype=np.float64)
+        cells.append({
+            "participation_rate": rate,
+            "final_gap": float(obj[-1]),
+            "rounds_to_eps": iterations_to_threshold(
+                obj, EPS, r.history.eval_iterations
+            ),
+            "floats_per_round_realized": realized,
+            "floats_per_round_analytic": analytic,
+            "gap_curve_every_200": obj[9::10].tolist(),
+        })
+        print(f"[participation] rate={rate}: final gap={obj[-1]:.4g}, "
+              f"floats/round {realized:.1f} (model {analytic:.1f})")
+    gaps = [c["final_gap"] for c in cells]
+    assert all(g == g and g != float("inf") for g in gaps), gaps
+    # Monotone degradation with sampling rate (rates are listed densest
+    # first): fewer participating clients per round converge no faster.
+    assert all(gaps[i] <= gaps[i + 1] * 1.05 for i in range(len(gaps) - 1)), (
+        f"convergence not monotone in participation rate: {gaps}"
+    )
+    for c in cells:
+        # The quadratic cost model holds to sampling noise.
+        ratio = (
+            c["floats_per_round_realized"] / c["floats_per_round_analytic"]
+        )
+        assert 0.9 < ratio < 1.1, (c["participation_rate"], ratio)
+    return {
+        "config": cfg0.to_dict(),
+        "eps": EPS,
+        "rates": list(RATES),
+        "cells": cells,
+        "note": (
+            "gap_curve_every_200 rows are the convergence-vs-"
+            "participation-rate curves (suboptimality at rounds 200, 400, "
+            "..., 2000); floats/round realized matches the q^2*sum(deg)*d "
+            "cost model within 10% per cell (asserted)"
+        ),
+    }, cfg0
+
+
+def _scale_cell(args):
+    """One (n, impl) throughput+memory cell; runs in a fresh subprocess so
+    peak RSS is per-cell, not a running max over the whole bench."""
+    n, impl = args
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np  # noqa: F401
+
+    from distributed_optimization_tpu.config import ExperimentConfig
+
+    cfg = ExperimentConfig(
+        n_workers=n, n_samples=2 * n, n_features=16,
+        n_informative_features=10, problem_type="quadratic",
+        topology="erdos_renyi", erdos_renyi_p=SCALE_MEAN_DEGREE / n,
+        algorithm="dsgd", local_batch_size=4,
+        n_iterations=SCALE_T, eval_every=SCALE_T, topology_impl=impl,
+    )
+    ds, f_opt = _problem(cfg)
+    t0 = time.perf_counter()
+    r = _run(cfg, ds, f_opt)
+    wall = time.perf_counter() - t0
+    return {
+        "n_workers": n,
+        "topology_impl": impl,
+        "resolved_impl": cfg.resolved_topology_impl(),
+        "iters_per_second": float(r.history.iters_per_second),
+        "compile_seconds": float(r.history.compile_seconds),
+        "wall_seconds": wall,
+        "peak_rss_mb": resource.getrusage(
+            resource.RUSAGE_SELF
+        ).ru_maxrss / 1024.0,
+        "final_gap": float(r.history.objective[-1]),
+    }
+
+
+def bench_scale():
+    jobs = []
+    for n in SCALE_N:
+        jobs.append((n, "neighbor"))
+        if n < DENSE_SKIP_N:
+            jobs.append((n, "dense"))
+    cells = []
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    for job in jobs:  # sequential: no co-tenant interference between cells
+        with futures.ProcessPoolExecutor(1, mp_context=ctx) as pool:
+            cell = pool.submit(_scale_cell, job).result()
+        cells.append(cell)
+        print(f"[scale] N={cell['n_workers']} impl={cell['topology_impl']}: "
+              f"{cell['iters_per_second']:.0f} iters/s, "
+              f"{cell['peak_rss_mb']:.0f} MB peak")
+    by_key = {(c["n_workers"], c["topology_impl"]): c for c in cells}
+    for n in SCALE_N:
+        nb = by_key.get((n, "neighbor"))
+        dn = by_key.get((n, "dense"))
+        if nb and dn:
+            # Honest per-cell flag, same convention as robust_scale.json.
+            nb["matrix_free_loses"] = (
+                nb["iters_per_second"] < dn["iters_per_second"]
+            )
+            nb["speedup_vs_dense"] = (
+                nb["iters_per_second"] / dn["iters_per_second"]
+            )
+    big = by_key[(DENSE_SKIP_N, "neighbor")]
+    assert big["final_gap"] == big["final_gap"], "N=10k run produced NaN gap"
+    assert big["iters_per_second"] > 0, big
+    return {
+        "cells": cells,
+        "dense_skipped_at": {
+            "n_workers": DENSE_SKIP_N,
+            "reason": (
+                "dense adjacency+mixing at N=10k is ~1.6 GB float64 before "
+                "one iteration runs (plus O(N^2 d) per-round work) — the "
+                "axis cap the matrix-free path removes; skipped by "
+                "arithmetic, not measured"
+            ),
+        },
+        "asserted": (
+            f"the N={DENSE_SKIP_N} matrix-free cell completed with finite "
+            "gap, recorded throughput and per-cell peak RSS"
+        ),
+    }
+
+
+def main() -> None:
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+    from distributed_optimization_tpu.utils.profiling import PhaseTimer
+
+    import jax
+
+    timer = PhaseTimer()
+    with timer.phase("local_steps"):
+        local_steps, cfg0 = bench_local_steps()
+    with timer.phase("participation"):
+        participation, _ = bench_participation()
+    with timer.phase("scale"):
+        scale = bench_scale()
+
+    payload = {
+        "device": jax.devices()[0].device_kind,
+        "platform": jax.devices()[0].platform,
+        "protocol": {
+            "eps": EPS,
+            "local_steps": (
+                "tau in {1,2,4,8} local SGD steps per gossip round (dsgd, "
+                "N=32 ring, shuffled partition), per-round comms constant; "
+                "floats-to-eps = rounds-to-eps x floats/round; >=2x "
+                "reduction for some tau>1 at a matched final-gap envelope "
+                "is asserted"
+            ),
+            "participation": (
+                "client sampling at rates {1.0,0.5,0.25}, fixed horizon; "
+                "convergence curves recorded, monotone degradation and "
+                "the q^2*sum(deg)*d floats/round cost model asserted"
+            ),
+            "scale": (
+                "throughput + per-cell-subprocess peak RSS for the "
+                "neighbor-table (matrix-free) path vs dense at N in "
+                "{1024, 4096, 10000} (sparse Erdős–Rényi, mean degree "
+                "~12, T=100 — the irregular-graph case where dense is an "
+                "[N,N] matmul per round and gather the only matrix-free "
+                "mixing); dense at N=10k is skipped by arithmetic with "
+                "the reason recorded"
+            ),
+        },
+        "local_steps": local_steps,
+        "participation": participation,
+        "scale": scale,
+        "gates": {
+            "floats_to_eps_reduction_floor": 2.0,
+            "best_floats_to_eps_reduction": local_steps[
+                "best_floats_reduction"
+            ],
+            "participation_rates_measured": len(participation["cells"]),
+            "max_n_completed_matrix_free": max(
+                c["n_workers"] for c in scale["cells"]
+                if c["topology_impl"] == "neighbor"
+            ),
+        },
+        "note": (
+            "CPU-container numbers: absolute iters/sec is not chip "
+            "evidence; the load-bearing content is the within-artifact "
+            "ratios (tau reductions, rate curves, dense-vs-neighbor "
+            "flags) and the N=10k completion itself. tau=1 / "
+            "participation=1.0 bitwise-reduction guarantees live in "
+            "tests/test_federated.py, not here."
+        ),
+    }
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(payload, indent=1, sort_keys=True) + "\n")
+    print(f"wrote {OUT}")
+    write_bench_manifest(OUT, config=cfg0, phases=timer)
+
+
+if __name__ == "__main__":
+    main()

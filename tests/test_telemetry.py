@@ -356,6 +356,9 @@ PERF_ARTIFACT_KEYS = {
         "coarse_cadence_hoisted_vs_inline", "device",
         "eval_dominated_demo_three_forms", "protocol"},
     "faults.json": {"config", "device", "note", "runs"},
+    "federated.json": {
+        "device", "platform", "protocol", "note", "local_steps",
+        "participation", "scale", "gates"},
     "fused_robust.json": {
         "bytes_vs_gap", "device", "fused_vs_gather", "gates", "note",
         "platform", "protocol"},
