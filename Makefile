@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test smoke serve-smoke bench-byzantine bench-churn \
 	bench-robust-scale bench-sweep bench-compute bench-telemetry \
-	bench-fused bench-serving bench-federated
+	bench-fused bench-serving bench-federated bench-async
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -22,7 +22,8 @@ smoke:
 		tests/test_robust_gather.py tests/test_fused_robust.py \
 		tests/test_compressed_gossip.py tests/test_batch.py \
 		tests/test_telemetry.py tests/test_serving.py \
-		tests/test_federated.py
+		tests/test_federated.py tests/test_async.py \
+		tests/test_matrix_free_faults.py
 
 # End-to-end serving smoke over real HTTP (docs/SERVING.md): boot the
 # daemon, submit 3 requests (2 structurally identical -> ONE compile via
@@ -77,6 +78,13 @@ bench-fused:
 # cells with the N=10k completion asserted).
 bench-federated:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_federated.py
+
+# Regenerate the asynchronous-gossip evidence (docs/perf/async.json:
+# sync vs async iters/wall-clock-to-eps on a shared simulated latency
+# realization — heavy-tail speedup floors, the constant-latency
+# degenerate gate asserted == sync one-peer <= 1e-12, oracle parity).
+bench-async:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_async.py
 
 # Regenerate the serving-layer evidence (docs/perf/serving.json:
 # executable-cache warm-vs-cold submit->start latency >= 10x floor,

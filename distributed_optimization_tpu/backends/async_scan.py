@@ -1,0 +1,406 @@
+"""Scan-over-events execution: the jax backend's asynchronous gossip path.
+
+Where the synchronous paths scan over ROUNDS (every iteration advances all
+N workers behind a barrier), this path scans over the EVENTS of a
+precomputed ``parallel/events.py`` timeline: each scan trip is one
+worker's local D-PSGD update at its realized staleness plus a
+pairwise-average gossip exchange — AD-PSGD-style asynchronous
+decentralized SGD (Lian et al. '17) with stragglers modeled as LATENCY in
+the schedule rather than as dropped rounds.
+
+Execution shape: the event schedule is static host data (pure in
+(topology, horizon, seed, latency model) — the ``build_fault_timeline``
+trick), threaded through jit as arrays, so the whole run compiles to ONE
+XLA program: an outer ``lax.scan`` over eval chunks whose body scans the
+chunk's ``eval_every * N`` events and computes the full-data metrics once,
+exactly on cadence. Per-event work is O(b·d + d): a single-worker batch
+gather, one gradient, and two dynamic row writes — there is no [N, N]
+object and no per-event host sync anywhere.
+
+Staleness mechanics inside the scan: the carry holds the live model stack
+``x`` AND the per-worker read snapshots ``x_read`` (the model each worker
+captured when it started its in-flight gradient). An event's gradient is
+evaluated at ``x_read[i]`` while the averaging acts on the LIVE rows —
+the gap between the two is exactly the realized staleness the timeline
+records per event (surfaced as a histogram in ``health_summary``).
+
+Resume-exactness: the timeline is rebuilt identically from the config,
+batch draws are counter-based in (seed, worker, local_step), and the
+carry is just ``{x, x_read}`` — so a run split at any eval boundary via
+``state0``/``start_event`` replays the identical tail events bitwise
+(tests/test_async.py pins it through a save/restore round-trip on both
+backends).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_optimization_tpu.backends.base import BackendRunResult
+from distributed_optimization_tpu.metrics import RunHistory
+from distributed_optimization_tpu.models import get_problem
+from distributed_optimization_tpu.ops.sampling import sample_batch_indices
+from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.parallel.events import build_event_timeline
+from distributed_optimization_tpu.serving.cache import (
+    resolve_cache,
+    sequential_cache_key,
+)
+from distributed_optimization_tpu.telemetry import cost_from_lowered
+from distributed_optimization_tpu.utils.data import HostDataset, stack_shards
+
+# PRNG stream tag for the event path's batch draws: per-event keys are
+# fold_in(fold_in(fold_in(key(seed), TAG), worker), local_step) — a
+# distinct stream from every synchronous sampler, counter-based in the
+# worker's OWN step count so a draw never depends on the interleaving.
+_ASYNC_BATCH_TAG = 0xA57E
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_timeline(
+    topology, n, er_p, topo_seed, horizon, seed, latency_model,
+    latency_mean, latency_tail,
+):
+    topo = build_topology(
+        topology, n, erdos_renyi_p=er_p, seed=topo_seed,
+    )
+    return topo, build_event_timeline(
+        topo, horizon, seed,
+        latency_model=latency_model, latency_mean=latency_mean,
+        latency_tail=latency_tail,
+    )
+
+
+def timeline_for(config):
+    """The event timeline this config's async run executes — identical for
+    the backends, the telemetry health block, and the bench (the
+    (seed, horizon)-pure contract). Schedules are deterministic in the
+    key below, so a small LRU makes the rebuilds one run triggers (jax or
+    numpy execution, then the health/RunTrace derivation, possibly a
+    serving manifest) share ONE build of the O(E) host unroll."""
+    return _cached_timeline(
+        config.topology, config.n_workers, config.erdos_renyi_p,
+        config.resolved_topology_seed(), config.n_iterations, config.seed,
+        config.latency_model, config.latency_mean, config.latency_tail,
+    )
+
+
+def _validate_slice(config, E: int, start_event: int, n_events: Optional[int]):
+    """Resolve and validate the executed [start, start+n) event window.
+
+    Eval boundaries are every ``eval_every * N`` events, so both ends must
+    land on one — otherwise the continuation's metric rows would not line
+    up with the one-shot run's.
+    """
+    n = config.n_workers
+    events_per_eval = config.eval_every * n
+    if n_events is None:
+        n_events = E - start_event
+    if not 0 <= start_event < E or start_event + n_events > E or n_events <= 0:
+        raise ValueError(
+            f"event window [{start_event}, {start_event + n_events}) is "
+            f"outside the schedule's {E} events"
+        )
+    if start_event % events_per_eval or n_events % events_per_eval:
+        raise ValueError(
+            f"event window must align to eval boundaries "
+            f"(eval_every * N = {events_per_eval} events): got start="
+            f"{start_event}, length={n_events}"
+        )
+    return n_events, events_per_eval
+
+
+def run_async(
+    config,
+    dataset: HostDataset,
+    f_opt: float,
+    *,
+    batch_schedule: Optional[np.ndarray] = None,
+    collect_metrics: bool = True,
+    measure_compile: bool = True,
+    return_state: bool = False,
+    state0: Optional[dict] = None,
+    start_event: int = 0,
+    n_events: Optional[int] = None,
+    executable_cache=None,
+) -> BackendRunResult:
+    """Run one asynchronous experiment (``config.execution == 'async'``).
+
+    ``batch_schedule [E_total, b]`` injects fixed per-EVENT batch indices
+    into the firing worker's shard (the oracle-equivalence convention —
+    the async twin of the synchronous ``[T, N, b]`` schedule).
+    ``state0``/``start_event``/``n_events`` continue a previous slice from
+    its ``final_state`` ({x, x_read} leaves): the schedule and the
+    counter-based batch draws are functions of the config alone, so the
+    continuation is exactly the one-shot program split in two (bitwise —
+    the resume-exactness contract). ``executable_cache`` follows the
+    sequential path's convention (docs/SERVING.md); the window facts are
+    part of the key.
+    """
+    from distributed_optimization_tpu.backends.base import x64_scope
+
+    with x64_scope(config):
+        return _run_async(
+            config, dataset, f_opt, batch_schedule=batch_schedule,
+            collect_metrics=collect_metrics,
+            measure_compile=measure_compile, return_state=return_state,
+            state0=state0, start_event=start_event, n_events=n_events,
+            executable_cache=executable_cache,
+        )
+
+
+def _run_async(
+    config,
+    dataset: HostDataset,
+    f_opt: float,
+    *,
+    batch_schedule,
+    collect_metrics: bool,
+    measure_compile: bool,
+    return_state: bool,
+    state0,
+    start_event: int,
+    n_events,
+    executable_cache,
+) -> BackendRunResult:
+    problem = get_problem(
+        config.problem_type, huber_delta=config.huber_delta,
+        n_classes=config.n_classes,
+    )
+    reg = config.reg_param
+    n = config.n_workers
+    device_data = stack_shards(dataset, dtype=np.dtype(config.dtype))
+    d_model = problem.param_dim(device_data.n_features)
+    dtype = device_data.X.dtype
+
+    topo, timeline = timeline_for(config)
+    E = timeline.n_events
+    n_events, events_per_eval = _validate_slice(
+        config, E, start_event, n_events
+    )
+    n_evals = n_events // events_per_eval
+    rounds_slice = n_events // n
+    start_round = start_event // n
+
+    sl = slice(start_event, start_event + n_events)
+    ev_chunks = {
+        "worker": jnp.asarray(
+            timeline.worker[sl].reshape(n_evals, events_per_eval)
+        ),
+        "partner": jnp.asarray(
+            timeline.partner[sl].reshape(n_evals, events_per_eval)
+        ),
+        "local_step": jnp.asarray(
+            timeline.local_step[sl].reshape(n_evals, events_per_eval)
+        ),
+    }
+    sched_sig = None
+    if batch_schedule is not None:
+        batch_schedule = np.asarray(batch_schedule)
+        if batch_schedule.shape[0] != E:
+            raise ValueError(
+                f"async batch_schedule carries {batch_schedule.shape[0]} "
+                f"event rows; the schedule has {E} events (one [b] index "
+                "row per event into the firing worker's shard)"
+            )
+        ev_chunks["schedule"] = jnp.asarray(
+            batch_schedule[sl].reshape(
+                n_evals, events_per_eval, batch_schedule.shape[1]
+            ),
+            dtype=jnp.int32,
+        )
+        sched_sig = tuple(batch_schedule.shape)
+
+    # --- initial carry ------------------------------------------------
+    x0 = jnp.zeros((n, d_model), dtype=dtype)
+    if state0 is None:
+        if start_event != 0:
+            raise ValueError(
+                "continuing from start_event > 0 needs the previous "
+                "slice's final_state ({x, x_read}) as state0"
+            )
+        st0 = {"x": x0, "x_read": x0}
+    else:
+        if set(state0) != {"x", "x_read"}:
+            raise ValueError(
+                f"async state0 leaves {sorted(state0)} do not match the "
+                "event-path carry ['x', 'x_read']"
+            )
+        st0 = {
+            k: jnp.asarray(v).astype(dtype) for k, v in state0.items()
+        }
+        for k, v in st0.items():
+            if v.shape != (n, d_model):
+                raise ValueError(
+                    f"state0[{k!r}] has shape {v.shape}; expected "
+                    f"{(n, d_model)}"
+                )
+
+    from distributed_optimization_tpu.backends.jax_backend import (
+        _make_eta_fn,
+        make_full_objective_fn,
+    )
+
+    eta_fn = _make_eta_fn(config)
+    full_objective = make_full_objective_fn(problem, reg)
+    batch_size = config.local_batch_size
+    L = device_data.X.shape[1]
+    full_batch = batch_schedule is None and batch_size >= L
+    track_consensus = collect_metrics and config.record_consensus
+    key = jax.random.fold_in(jax.random.key(config.seed), _ASYNC_BATCH_TAG)
+
+    data_args = {
+        "X": jnp.asarray(device_data.X),
+        "y": jnp.asarray(device_data.y),
+        "n_valid": jnp.asarray(device_data.n_valid),
+        "ev": ev_chunks,
+    }
+
+    def run_scan(state, data):
+        X, y, n_valid = data["X"], data["y"], data["n_valid"]
+
+        def event_grad(x_read_i, ev):
+            i, k = ev["worker"], ev["local_step"]
+            Xi, yi, ni = X[i], y[i], n_valid[i]
+            if "schedule" in ev:
+                idx = ev["schedule"]
+                Xb, yb = Xi[idx], yi[idx]
+                wts = jnp.full(
+                    idx.shape, 1.0 / idx.shape[0], dtype=dtype
+                )
+            elif full_batch:
+                mask = (jnp.arange(L) < ni).astype(dtype)
+                wts = mask / jnp.maximum(ni.astype(dtype), 1.0)
+                Xb, yb = Xi, yi
+            else:
+                wkey = jax.random.fold_in(jax.random.fold_in(key, i), k)
+                idx, w = sample_batch_indices(wkey, L, ni, batch_size)
+                Xb, yb = Xi[idx], yi[idx]
+                wts = w.astype(dtype)
+            return problem.gradient_weighted(x_read_i, Xb, yb, wts, reg)
+
+        def event_step(carry, ev):
+            x, x_read = carry["x"], carry["x_read"]
+            i, j = ev["worker"], ev["partner"]
+            g = event_grad(x_read[i], ev)
+            eta = eta_fn(ev["local_step"]).astype(dtype)
+            xi, xj = x[i], x[j]
+            matched = j != i
+            avg = (0.5 * (xi + xj)).astype(dtype)
+            # D-PSGD ordering (Lian et al. '17 Alg. 1): average the live
+            # rows, then worker i descends along its (stale) gradient;
+            # the passive partner only averages. Writing j before i keeps
+            # the solo case (j == i, isolated node) a plain local step.
+            new_i = (jnp.where(matched, avg, xi) - eta * g).astype(dtype)
+            new_j = jnp.where(matched, avg, xj)
+            x = x.at[j].set(new_j)
+            x = x.at[i].set(new_i)
+            # Worker i immediately re-reads and starts its next gradient.
+            x_read = x_read.at[i].set(new_i)
+            return {"x": x, "x_read": x_read}, None
+
+        def chunk_body(carry, ev_row):
+            carry, _ = jax.lax.scan(event_step, carry, ev_row)
+            out = {}
+            if collect_metrics:
+                x = carry["x"]
+                xbar = jnp.mean(x, axis=0)
+                out["gap"] = full_objective(xbar, X, y, n_valid) - f_opt
+                if track_consensus:
+                    out["cons"] = jnp.mean(
+                        jnp.sum((x - xbar[None, :]) ** 2, axis=1)
+                    )
+            return carry, out
+
+        return jax.lax.scan(chunk_body, state, data["ev"])
+
+    # AOT compile with the sequential path's cache convention: the event
+    # arrays and the carry are traced inputs, so the key only needs the
+    # full config hash + the window/schedule trace facts.
+    exec_cache = resolve_cache(executable_cache)
+    cache_key = cached = None
+    if exec_cache is not None:
+        cache_key = sequential_cache_key(
+            config, f_opt, device_data,
+            schedule_signature=(
+                "async", start_event, n_events, state0 is not None,
+                sched_sig,
+            ),
+            collect_metrics=collect_metrics,
+        )
+        cached = exec_cache.get(cache_key)
+    if cached is not None:
+        compiled = cached.executable
+        compile_seconds = 0.0
+    else:
+        t0c = time.perf_counter()
+        with jax.default_matmul_precision(config.matmul_precision):
+            lowered = jax.jit(run_scan).lower(st0, data_args)
+            cost = cost_from_lowered(lowered)
+            compiled = lowered.compile()
+        cold_seconds = time.perf_counter() - t0c
+        compile_seconds = cold_seconds if measure_compile else 0.0
+        if exec_cache is not None:
+            exec_cache.put(
+                cache_key, compiled, cost=cost,
+                compile_seconds=cold_seconds,
+            )
+
+    t1 = time.perf_counter()
+    final_state, ys = compiled(st0, data_args)
+    final_state = jax.block_until_ready(final_state)
+    run_seconds = time.perf_counter() - t1
+
+    gap_hist = (
+        np.asarray(ys["gap"], dtype=np.float64)
+        if "gap" in ys else np.full(n_evals, np.nan)
+    )
+    cons_hist = (
+        np.asarray(ys["cons"], dtype=np.float64) if "cons" in ys else None
+    )
+    # Comms accounting: every matched event moves one pairwise exchange —
+    # both models cross the wire, 2·d floats (a solo event moves none).
+    matched_slice = int(np.sum(timeline.matched()[sl]))
+    total_floats = 2.0 * d_model * matched_slice
+
+    history = RunHistory(
+        objective=gap_hist,
+        consensus_error=cons_hist,
+        time=np.linspace(
+            run_seconds / max(n_evals, 1), run_seconds, n_evals
+        ),
+        time_measured=False,
+        # Round-based iteration numbering (N events per round), so
+        # iters-to-ε stays comparable with the synchronous paths.
+        eval_iterations=np.arange(
+            start_round + config.eval_every,
+            start_round + rounds_slice + 1,
+            config.eval_every,
+        ),
+        total_floats_transmitted=total_floats,
+        iters_per_second=(
+            rounds_slice / run_seconds if run_seconds > 0 else float("nan")
+        ),
+        compile_seconds=compile_seconds,
+        spectral_gap=topo.spectral_gap,
+    )
+    final_models = np.asarray(final_state["x"]).astype(np.float64)
+    return BackendRunResult(
+        history=history,
+        final_models=final_models,
+        final_avg_model=final_models.mean(axis=0),
+        final_state=(
+            {
+                k: np.asarray(v).astype(np.float64)
+                for k, v in final_state.items()
+            }
+            if return_state
+            else None
+        ),
+    )

@@ -570,6 +570,9 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
             and fused_auto_ok
             and faulty is None
             and not config.telemetry
+            # Matrix-free topologies run the gather form only: the fused
+            # kernel is measured on the dense-representation shapes.
+            and not topo.is_matrix_free
             # The fused-kernel measurement covers the one-step round; with
             # τ local steps auto stays on gather (an EXPLICIT 'fused'
             # still runs — the kernel is the round's first descent and the
@@ -586,12 +589,26 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
                 "batched program: the pallas kernel addresses unbatched "
                 "VMEM blocks — use 'auto', 'gather', or 'dense'"
             )
+        if topo.is_matrix_free and robust_impl != "gather":
+            # Unreachable through config validation (neighbor topologies
+            # never have k_max + 1 >= N, so 'auto' resolves to gather and
+            # explicit dense/fused are rejected up front) — guard anyway
+            # so a future resolver change fails loudly, not silently
+            # through a None adjacency.
+            raise ValueError(
+                f"matrix-free robust aggregation runs in gather form; "
+                f"resolved robust_impl={robust_impl!r} needs the dense "
+                "[N, N] adjacency"
+            )
         if robust_impl in ("gather", "fused"):
             from distributed_optimization_tpu.parallel.topology import (
-                neighbor_table,
+                neighbor_tables_for,
             )
 
-            nbr_idx, nbr_mask = neighbor_table(topo.adjacency)
+            # Native tables for matrix-free topologies (the satellite:
+            # Byzantine screening accepted on the neighbor path), derived
+            # from the dense adjacency otherwise — identical layout.
+            nbr_idx, nbr_mask = neighbor_tables_for(topo)
             if robust_impl == "fused":
                 gather_agg = make_fused_robust_aggregator(
                     config.aggregation, config.robust_b, nbr_idx, ct,
@@ -1001,6 +1018,38 @@ def run(
     """
     from distributed_optimization_tpu.backends.base import x64_scope
 
+    if config.execution == "async":
+        # Event-driven asynchronous gossip (docs/ASYNC.md): a scan over
+        # the precomputed event schedule instead of rounds. The
+        # round-based execution knobs below have no event form — reject
+        # loudly rather than silently ignoring them.
+        from distributed_optimization_tpu.backends import async_scan
+
+        if checkpoint is not None:
+            raise ValueError(
+                "execution='async' does not take the round-chunked "
+                "checkpoint machinery; continue a run exactly via "
+                "async_scan.run_async(state0=..., start_event=...) — the "
+                "event schedule and batch draws rebuild from the config"
+            )
+        if measure_timestamps:
+            raise ValueError(
+                "execution='async' reports the event schedule's simulated "
+                "VIRTUAL clock (telemetry.async health block), not "
+                "host-driven per-eval timestamps"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "execution='async' runs unsharded: events are a totally "
+                "ordered sequential schedule, which a worker mesh cannot "
+                "partition"
+            )
+        return async_scan.run_async(
+            config, dataset, f_opt, batch_schedule=batch_schedule,
+            collect_metrics=collect_metrics,
+            measure_compile=measure_compile, return_state=return_state,
+            executable_cache=executable_cache,
+        )
     with x64_scope(config):
         return _run(
             config, dataset, f_opt, mesh=mesh, use_mesh=use_mesh,
@@ -1790,6 +1839,14 @@ def batch_unsupported_reason(config) -> Optional[str]:
             "run_batch and tp_degree > 1 are mutually exclusive: the TP "
             "path pins a 2-D (workers, model) device mesh that the "
             "replica vmap axis cannot wrap"
+        )
+    if config.execution == "async":
+        return (
+            "run_batch does not support execution='async': the event "
+            "path is a sequential scan over one totally ordered schedule "
+            "per seed, and the per-replica schedules have different "
+            "event ORDERS (the order is data, but the staleness replay "
+            "is not) — run seeds sequentially"
         )
     return None
 

@@ -66,6 +66,183 @@ _SUPPORTED = (
 _MATRIX_FORM = ("gradient_tracking", "extra", "admm", "choco", "push_sum")
 
 
+def run_async(
+    config,
+    dataset: HostDataset,
+    f_opt: float,
+    *,
+    batch_schedule: Optional[np.ndarray] = None,
+    collect_metrics: bool = True,
+    state0: Optional[dict] = None,
+    start_event: int = 0,
+    n_events: Optional[int] = None,
+    return_state: bool = False,
+) -> BackendRunResult:
+    """Per-event float64 twin of the jax scan-over-events path.
+
+    The event SCHEDULE comes from the shared host-side builder
+    (``parallel/events.py`` — the fault-timeline convention: both backends
+    agree on who fires when, with whom, and at what staleness), while the
+    per-event update math — pairwise average, stale-read gradient step,
+    the read-snapshot bookkeeping — is an independent float64
+    implementation written from the AD-PSGD recursion. Batch draws:
+    ``batch_schedule [E, b]`` injects per-event indices into the firing
+    worker's shard (the oracle-equivalence convention; standalone runs
+    draw from a host Generator, which the jax counter-based stream cannot
+    and need not reproduce). ``state0``/``start_event``/``n_events``
+    continue a previous slice exactly like the jax twin.
+    """
+    from distributed_optimization_tpu.backends.async_scan import (
+        _validate_slice,
+        timeline_for,
+    )
+
+    n = config.n_workers
+    reg = config.reg_param
+    d, objective, gradient, shards, shard_sizes = _problem_setup(
+        config, dataset
+    )
+
+    topo, timeline = timeline_for(config)
+    E = timeline.n_events
+    n_events, events_per_eval = _validate_slice(
+        config, E, start_event, n_events
+    )
+    if batch_schedule is not None and len(batch_schedule) != E:
+        # Same contract (and message shape) as the jax twin: the schedule
+        # is indexed by ABSOLUTE event id, so a window-length schedule on
+        # a continued slice is the caller bug this catches.
+        raise ValueError(
+            f"async batch_schedule carries {len(batch_schedule)} event "
+            f"rows; the schedule has {E} events (one [b] index row per "
+            "event into the firing worker's shard)"
+        )
+    n_evals = n_events // events_per_eval
+    rounds_slice = n_events // n
+    start_round = start_event // n
+
+    if state0 is None:
+        if start_event != 0:
+            raise ValueError(
+                "continuing from start_event > 0 needs the previous "
+                "slice's final_state ({x, x_read}) as state0"
+            )
+        x = np.zeros((n, d))
+        x_read = np.zeros((n, d))
+    else:
+        if set(state0) != {"x", "x_read"}:
+            raise ValueError(
+                f"async state0 leaves {sorted(state0)} do not match the "
+                "event-path carry ['x', 'x_read']"
+            )
+        x = np.array(state0["x"], dtype=np.float64, copy=True)
+        x_read = np.array(state0["x_read"], dtype=np.float64, copy=True)
+
+    # Standalone batch draws are COUNTER-BASED in (seed, worker, local
+    # step) — one fresh Generator per event, like the jax twin's folded
+    # keys (independent stream, same contract): a draw never depends on
+    # the event interleaving or on how the run is split, which is what
+    # makes the continuation path bitwise without an injected schedule.
+    def event_batch(i: int, k: int) -> np.ndarray:
+        b = min(config.local_batch_size, shard_sizes[i])
+        if b <= 0:
+            return np.empty(0, dtype=np.int64)
+        erng = np.random.default_rng(
+            [config.seed & 0xFFFFFFFF, 0xA57E, i, k]
+        )
+        return erng.choice(shard_sizes[i], size=b, replace=False)
+
+    eta0 = config.learning_rate_eta0
+    sqrt_decay = config.resolved_lr_schedule() == "sqrt_decay"
+    track_consensus = collect_metrics and config.record_consensus
+    gap_hist = np.full(n_evals, np.nan)
+    cons_hist = np.full(n_evals, np.nan)
+    time_hist = np.empty(n_evals)
+
+    start = time.perf_counter()
+    for off in range(n_events):
+        e = start_event + off
+        i = int(timeline.worker[e])
+        j = int(timeline.partner[e])
+        k = int(timeline.local_step[e])
+        Xi, yi = shards[i]
+        if batch_schedule is not None:
+            idx = np.asarray(batch_schedule[e])
+        else:
+            idx = event_batch(i, k)
+        g = gradient(x_read[i], Xi[idx], yi[idx], reg)
+        eta = eta0 / np.sqrt(k + 1.0) if sqrt_decay else eta0
+        if j != i:
+            # D-PSGD ordering: average the live pair, then the firing
+            # worker descends along its stale-read gradient.
+            avg = 0.5 * (x[i] + x[j])
+            x[j] = avg
+            x[i] = avg - eta * g
+        else:  # solo event (isolated node): plain local step
+            x[i] = x[i] - eta * g
+        x_read[i] = x[i].copy()
+        if (off + 1) % events_per_eval == 0:
+            row = (off + 1) // events_per_eval - 1
+            if collect_metrics:
+                xbar = x.mean(axis=0)
+                gap_hist[row] = (
+                    objective(xbar, dataset.X_full, dataset.y_full, reg)
+                    - f_opt
+                )
+                if track_consensus:
+                    cons_hist[row] = consensus_error(x)
+            time_hist[row] = time.perf_counter() - start
+    run_seconds = time.perf_counter() - start
+
+    matched_slice = int(
+        np.sum(timeline.matched()[start_event:start_event + n_events])
+    )
+    history = RunHistory(
+        objective=gap_hist,
+        consensus_error=cons_hist if track_consensus else None,
+        time=time_hist,
+        time_measured=True,
+        eval_iterations=np.arange(
+            start_round + config.eval_every,
+            start_round + rounds_slice + 1,
+            config.eval_every,
+        ),
+        # Every matched event is one pairwise exchange: 2·d floats.
+        total_floats_transmitted=2.0 * d * matched_slice,
+        iters_per_second=(
+            rounds_slice / run_seconds if run_seconds > 0 else float("inf")
+        ),
+        spectral_gap=topo.spectral_gap,
+    )
+    return BackendRunResult(
+        history=history,
+        final_models=x,
+        final_avg_model=x.mean(axis=0),
+        final_state=(
+            {"x": x, "x_read": x_read} if return_state else None
+        ),
+    )
+
+
+def _problem_setup(config, dataset: HostDataset):
+    """Shared host problem prelude for the sync and async oracle paths:
+    (d, objective, gradient, shards, shard_sizes). ``d`` is the TRAINED
+    dimension — the softmax family's flat [d·K] matrix, ``n_features``
+    for the scalar GLMs (mirrors jax_backend's ``problem.param_dim``
+    without importing the jax problem registry)."""
+    d = dataset.n_features
+    if config.problem_type == "softmax":
+        d = dataset.n_features * config.n_classes
+    objective = losses_np.OBJECTIVES[config.problem_type]
+    gradient = losses_np.GRADIENTS[config.problem_type]
+    if config.problem_type == "huber":
+        objective = functools.partial(objective, delta=config.huber_delta)
+        gradient = functools.partial(gradient, delta=config.huber_delta)
+    shards = [dataset.shard(i) for i in range(config.n_workers)]
+    shard_sizes = [Xi.shape[0] for Xi, _ in shards]
+    return d, objective, gradient, shards, shard_sizes
+
+
 def _topk_rows(v: np.ndarray, k: int) -> np.ndarray:
     """Per-row top-k-by-magnitude compressor (Koloskova et al. '19 §2, the
     deterministic contraction): keep the k largest |v| entries per row, zero
@@ -86,6 +263,13 @@ def run(
     batch_schedule: Optional[np.ndarray] = None,
     collect_metrics: bool = True,
 ) -> BackendRunResult:
+    if config.execution == "async":
+        # Event-driven asynchronous gossip (docs/ASYNC.md): per-event
+        # float64 twin of the jax scan-over-events path.
+        return run_async(
+            config, dataset, f_opt, batch_schedule=batch_schedule,
+            collect_metrics=collect_metrics,
+        )
     if config.algorithm not in _SUPPORTED:
         raise ValueError(
             f"numpy backend implements {_SUPPORTED} (the reference's "
@@ -153,21 +337,10 @@ def run(
             )
     T = config.n_iterations
     n = config.n_workers
-    # Trained parameter dimension: the softmax family's flat [d·K] matrix,
-    # n_features for the scalar GLMs (mirrors jax_backend's
-    # problem.param_dim without importing the jax problem registry).
-    d = dataset.n_features
-    if config.problem_type == "softmax":
-        d = dataset.n_features * config.n_classes
     reg = config.reg_param
-    objective = losses_np.OBJECTIVES[config.problem_type]
-    gradient = losses_np.GRADIENTS[config.problem_type]
-    if config.problem_type == "huber":
-        objective = functools.partial(objective, delta=config.huber_delta)
-        gradient = functools.partial(gradient, delta=config.huber_delta)
-
-    shards = [dataset.shard(i) for i in range(n)]
-    shard_sizes = [Xi.shape[0] for Xi, _ in shards]
+    d, objective, gradient, shards, shard_sizes = _problem_setup(
+        config, dataset
+    )
 
     if config.compression in ("random_k", "qsgd"):
         raise ValueError(

@@ -33,6 +33,8 @@ from distributed_optimization_tpu.config import (
     ATTACKS,
     BACKENDS,
     COMPRESSIONS,
+    EXECUTIONS,
+    LATENCY_MODELS,
     MATRIX_FREE_AUTO_N,
     PROBLEM_TYPES,
     REJOINS,
@@ -303,6 +305,29 @@ def build_parser() -> argparse.ArgumentParser:
                      default=_DEFAULTS.suboptimality_threshold)
 
     execg = p.add_argument_group("execution")
+    execg.add_argument("--execution", choices=EXECUTIONS,
+                       default=_DEFAULTS.execution,
+                       help="'async' scans a precomputed EVENT schedule "
+                            "(AD-PSGD-style bounded-staleness gossip: one "
+                            "worker's stale-read local step + a pairwise "
+                            "exchange per event; stragglers are latency, "
+                            "not drops — docs/ASYNC.md). n_iterations "
+                            "then counts per-worker gradient steps (N "
+                            "events per round); dsgd only")
+    execg.add_argument("--latency-model", choices=LATENCY_MODELS,
+                       default=_DEFAULTS.latency_model,
+                       help="per-worker compute-time distribution of the "
+                            "async event schedule (all matched to mean "
+                            "--latency-mean; async only)")
+    execg.add_argument("--latency-mean", type=float,
+                       default=_DEFAULTS.latency_mean,
+                       help="mean compute time per gradient step in "
+                            "virtual seconds (async only)")
+    execg.add_argument("--latency-tail", type=float,
+                       default=_DEFAULTS.latency_tail,
+                       help="heavy-tail straggler knob: lognormal log-std "
+                            "(> 0) or pareto shape alpha (> 1); 0 for "
+                            "constant/exponential (async only)")
     execg.add_argument("--tp", type=int, default=_DEFAULTS.tp_degree,
                        metavar="TP_DEGREE",
                        help="tensor parallelism: shard the softmax [d, K] "
@@ -436,6 +461,10 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         choco_gamma=args.choco_gamma,
         local_steps=args.local_steps,
         participation_rate=args.participation_rate,
+        execution=args.execution,
+        latency_model=args.latency_model,
+        latency_mean=args.latency_mean,
+        latency_tail=args.latency_tail,
         topology_impl=args.topology_impl,
         seed=args.seed,
         topology_seed=args.topology_seed,

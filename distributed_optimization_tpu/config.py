@@ -63,6 +63,21 @@ ATTACKS = ("none", "sign_flip", "large_noise", "alie")
 # REJOIN_POLICIES mirrors this constant; config stays jax-free).
 REJOINS = ("frozen", "neighbor_restart")
 
+# Execution modes (docs/ASYNC.md): 'sync' is the bulk-synchronous scan
+# over rounds (every path before ISSUE-9); 'async' scans over a
+# precomputed EVENT schedule (parallel/events.py) — AD-PSGD-style
+# bounded-staleness gossip where each event is one worker's local
+# gradient step at its realized staleness plus a pairwise-average
+# exchange, and stragglers are modeled as LATENCY, not drops.
+EXECUTIONS = ("sync", "async")
+
+# Latency models for the asynchronous event schedule's per-worker
+# compute-time draws (parallel/events.py LATENCY_MODELS mirrors this
+# constant; config stays numpy/jax-free). All are normalized to mean
+# ``latency_mean``; ``latency_tail`` is the shape knob (lognormal
+# log-std, pareto alpha) for the heavy-tailed straggler regimes.
+LATENCY_MODELS = ("constant", "exponential", "lognormal", "pareto")
+
 # Robust neighbor-aggregation rules (ops/robust_aggregation.py) replacing
 # plain W @ x gossip: coordinate-wise trimmed mean / median over the closed
 # neighborhood, and self-centered clipping (ClippedGossip, He-Karimireddy-
@@ -269,6 +284,25 @@ class ExperimentConfig:
     # churn, bursty links and the Byzantine layer. 1.0 = everyone, every
     # round — bitwise the no-sampling program (no fault machinery traced).
     participation_rate: float = 1.0
+    # --- event-driven asynchronous execution (docs/ASYNC.md) ---
+    # 'sync' | 'async'. 'async' replaces the bulk-synchronous round scan
+    # with a scan over a precomputed event schedule
+    # (parallel/events.py::build_event_timeline): n_iterations then counts
+    # per-worker gradient steps (N events per "round", the same total
+    # gradient budget as the synchronous run), eval_every keeps its
+    # round-based meaning, and wall-clock comparisons use the schedule's
+    # simulated VIRTUAL clock. All four fields are structural for the
+    # serving cache: the event schedule is baked into the traced program.
+    execution: str = "sync"
+    # Latency distribution of the per-worker compute-time draws (see
+    # LATENCY_MODELS); only meaningful with execution='async'.
+    latency_model: str = "constant"
+    # Mean compute time per gradient step in virtual seconds (every model
+    # is matched-mean, so the tail knob never changes expected compute).
+    latency_mean: float = 1.0
+    # Heavy-tail straggler knob: lognormal log-std (> 0) or pareto shape
+    # alpha (> 1); must stay 0 for constant/exponential (no tail shape).
+    latency_tail: float = 0.0
     # 'auto' | 'dense' | 'neighbor'. Topology representation: 'dense'
     # builds the [N, N] adjacency + mixing matrix (every pre-federated
     # path); 'neighbor' is the matrix-free form — a padded [N, k_max]
@@ -666,23 +700,20 @@ class ExperimentConfig:
                     f"{self.mixing_impl!r} consumes — use 'auto', "
                     "'gather', or 'stencil'"
                 )
-            if self.attack != "none" or (
-                self.aggregation != "gossip" and self.robust_b > 0
-            ):
+            if (
+                self.attack != "none"
+                or (self.aggregation != "gossip" and self.robust_b > 0)
+            ) and self.robust_impl not in ("auto", "gather"):
+                # ISSUE-9 satellite: the matrix-free path ACCEPTS Byzantine
+                # screening in its gather form (the neighbor table IS the
+                # gather path's input); only the [N, N]-materializing
+                # execution forms stay dense-only.
                 raise ValueError(
-                    "topology_impl='neighbor' does not compose with "
-                    "Byzantine injection / robust aggregation yet: the "
-                    "screening path composes through the dense "
-                    "realized_adjacency — run defense studies on "
-                    "topology_impl='dense'"
-                )
-            if self.edge_drop_prob > 0.0:
-                raise ValueError(
-                    "topology_impl='neighbor' supports the node-process "
-                    "fault modes (participation_rate, straggler_prob, "
-                    "mttf/mttr churn); per-edge drop processes "
-                    "(edge_drop_prob/burst_len) need the dense edge "
-                    "machinery — use topology_impl='dense'"
+                    f"topology_impl='neighbor' runs robust aggregation in "
+                    f"gather form over the [N, k_max] table; robust_impl="
+                    f"{self.robust_impl!r} materializes dense/VMEM objects "
+                    "the matrix-free path never builds — use 'auto' or "
+                    "'gather'"
                 )
             if self.gossip_schedule != "synchronous":
                 raise ValueError(
@@ -695,6 +726,131 @@ class ExperimentConfig:
                     "topology_impl='neighbor' does not compose with "
                     "tp_degree > 1 (the TP path pins its own ring "
                     "stencil over a device mesh)"
+                )
+        if self.execution not in EXECUTIONS:
+            raise ValueError(f"Unknown execution mode: {self.execution}")
+        if self.latency_model not in LATENCY_MODELS:
+            raise ValueError(f"Unknown latency model: {self.latency_model}")
+        if self.execution == "sync":
+            if (
+                self.latency_model != "constant"
+                or self.latency_mean != 1.0
+                or self.latency_tail != 0.0
+            ):
+                raise ValueError(
+                    "latency_model/latency_mean/latency_tail shape the "
+                    "asynchronous event schedule; execution='sync' would "
+                    "silently ignore them — set execution='async'"
+                )
+        else:  # execution == 'async' (docs/ASYNC.md)
+            if self.latency_mean <= 0.0:
+                raise ValueError(
+                    f"latency_mean must be positive, got {self.latency_mean}"
+                )
+            if self.latency_model == "lognormal" and self.latency_tail <= 0.0:
+                raise ValueError(
+                    "latency_model='lognormal' needs latency_tail > 0 "
+                    "(the log-std tail knob)"
+                )
+            if self.latency_model == "pareto" and self.latency_tail <= 1.0:
+                raise ValueError(
+                    "latency_model='pareto' needs latency_tail > 1 (the "
+                    "shape alpha; alpha <= 1 has no finite mean)"
+                )
+            if (
+                self.latency_model in ("constant", "exponential")
+                and self.latency_tail != 0.0
+            ):
+                raise ValueError(
+                    f"latency_tail only shapes the lognormal/pareto tails; "
+                    f"latency_model={self.latency_model!r} would silently "
+                    "ignore it"
+                )
+            if self.backend == "cpp":
+                raise ValueError(
+                    "execution='async' is unsupported on the cpp backend "
+                    "(its native kernel hard-codes the synchronous round); "
+                    "use backend='jax' or the numpy oracle"
+                )
+            if self.algorithm != "dsgd":
+                raise ValueError(
+                    f"execution='async' is unsupported for "
+                    f"{self.algorithm!r}: an event applies ONE worker's "
+                    "D-PSGD update (pairwise average + local step at its "
+                    "realized staleness) — gradient tracking's paired "
+                    "tracker exchange, EXTRA/ADMM's static-W fixed points, "
+                    "CHOCO's shared estimates and push-sum's mass pair "
+                    "have no per-event form — use algorithm='dsgd'"
+                )
+            if self.topology in DIRECTED_TOPOLOGIES:
+                raise ValueError(
+                    "execution='async' realizes mutual pairwise exchanges; "
+                    f"directed topology {self.topology!r} has one-way links"
+                )
+            if self.gossip_schedule != "synchronous":
+                raise ValueError(
+                    "execution='async' IS a gossip schedule (the event "
+                    "timeline's presampled pairings); gossip_schedule="
+                    f"{self.gossip_schedule!r} would impose a second one — "
+                    "leave gossip_schedule='synchronous'"
+                )
+            if (
+                self.edge_drop_prob > 0.0
+                or self.straggler_prob > 0.0
+                or self.mttf > 0.0
+                or self.participation_rate < 1.0
+            ):
+                raise ValueError(
+                    "execution='async' models stragglers as LATENCY in the "
+                    "event schedule (latency_model/latency_tail), not as "
+                    "drops; the round-indexed fault processes "
+                    "(edge_drop_prob/straggler_prob/mttf/participation_"
+                    "rate) have no event-schedule form yet — run fault "
+                    "studies on execution='sync'"
+                )
+            if self.attack != "none" or (
+                self.aggregation != "gossip" and self.robust_b > 0
+            ):
+                raise ValueError(
+                    "execution='async' does not compose with Byzantine "
+                    "injection / robust aggregation: screening needs "
+                    "multiple received messages per aggregation, but an "
+                    "event delivers exactly one pairwise exchange — no "
+                    "trimming/clipping budget is realizable"
+                )
+            if self.compression != "none":
+                raise ValueError(
+                    "execution='async' does not compose with compressed "
+                    "gossip: the error-feedback estimate exchange assumes "
+                    "synchronized rounds, which the event schedule removes"
+                )
+            if self.local_steps > 1:
+                raise ValueError(
+                    "execution='async' already decouples gradient steps "
+                    "from exchanges per worker; local_steps > 1 is a "
+                    "round-based lever — use the latency model instead"
+                )
+            if self.tp_degree > 1 or self.replicas > 1:
+                raise ValueError(
+                    "execution='async' is a sequential scan over a totally "
+                    "ordered event schedule; the tensor-parallel mesh and "
+                    "the replica vmap axis have no event form — run "
+                    "tp_degree=1, replicas=1"
+                )
+            if self.topology_impl == "neighbor":
+                raise ValueError(
+                    "execution='async' scans events over the dense-"
+                    "representation topology (its regime is modest N with "
+                    "long horizons, not the matrix-free 10k+ axis); use "
+                    "topology_impl='dense' or 'auto'"
+                )
+            if self.telemetry:
+                raise ValueError(
+                    "execution='async' records no in-scan trace buffers "
+                    "(the staleness histogram and virtual-clock skew are "
+                    "derived from the presampled event timeline and appear "
+                    "in health_summary/RunTrace without telemetry) — set "
+                    "telemetry=False"
                 )
         if self.gossip_schedule not in ("synchronous", "one_peer",
                                         "round_robin"):
@@ -914,10 +1070,16 @@ class ExperimentConfig:
             self.backend != "jax"
             or self.topology not in NEIGHBOR_TOPOLOGIES
             or self.mixing_impl not in ("auto", "gather", "stencil")
+            # Byzantine screening DOES run matrix-free now (gather form,
+            # ISSUE-9 satellite) but stays an explicit opt-in: auto keeps
+            # defense studies on the dense path where every execution
+            # form (dense/gather/fused) is comparable. Edge-fault
+            # processes are no longer dense-only — the [horizon, E]
+            # chains index through the (node, slot)→edge-id table.
             or self.attack != "none"
             or (self.aggregation != "gossip" and self.robust_b > 0)
-            or self.edge_drop_prob > 0.0
             or self.gossip_schedule != "synchronous"
+            or self.execution == "async"
             or self.tp_degree > 1
         )
         if not dense_only_feature and self.n_workers >= MATRIX_FREE_AUTO_N:

@@ -1,0 +1,418 @@
+"""Precomputed event timelines for asynchronous (AD-PSGD-style) gossip.
+
+Everything else in the repo is bulk-synchronous: every round ends at a
+barrier, so one straggling worker stalls all N — wall-clock per round is
+the MAX of N compute-time draws, which under heavy-tailed latency grows
+like the extreme value of the distribution while the mean stays put.
+Asynchronous decentralized SGD (Lian et al. '17, AD-PSGD; the
+overlap-communication execution of Assran et al. '19) removes the barrier:
+each worker fires its own gradient+gossip events at its own pace, so
+per-event cost stops depending on the slowest worker while the convergence
+rate (per gradient step) matches the synchronous analysis under bounded
+staleness.
+
+The jit-ability trick is the one that made bursty faults scannable
+(``parallel/faults.py::build_fault_timeline``): because the event ORDER
+depends only on presampled per-worker compute-time draws — never on the
+optimization state — the whole asynchronous execution can be unrolled once
+at setup into a static, totally ordered EVENT SCHEDULE (host arrays), and
+the backend then scans over events instead of rounds. The schedule is a
+pure function of (topology, horizon, seed, latency model): rebuilt
+identically on every backend and after every resume, with NO carried RNG.
+
+Event model (one event = one worker finishing a gradient computation):
+
+- Worker i draws compute durations ``dur[k, i]`` from the configured
+  latency distribution (``latency_model`` / ``latency_mean`` /
+  ``latency_tail``) and finishes its k-th gradient at virtual time
+  ``T_i(k) = Σ_{r<=k} dur[r, i]`` — it starts its next computation
+  immediately after its own event completes (communication is modeled as
+  instantaneous against compute, the AD-PSGD atomic-average abstraction).
+- At its event, worker i holds a gradient computed at the SNAPSHOT it read
+  when the computation started (its model right after its previous own
+  event). Its live model may have moved since: initiating peers average
+  into it, and a pairwise average writes BOTH rows. That gap is the
+  event's realized STALENESS — recorded per event as the number of times
+  row i was written between read and fire.
+- Gossip pairings come from the SAME Boyd et al. '06 mutual-matching
+  machinery the synchronous one-peer schedule samples
+  (``parallel/faults.py::sample_one_peer_matching``, identical key
+  stream): round k has an involution P_k over the static topology, and
+  the pair {i, j = P_k[i]} exchanges ONCE per round, at the event of its
+  INITIATOR min(i, j) — whenever that worker reaches its k-th event,
+  regardless of how far its partner's clock has drifted. The initiator's
+  event applies the D-PSGD-ordered update
+
+      x_i, x_j <- (x_i + x_j)/2        (pairwise average, atomic)
+      x_i      <- x_i - eta_k * g_i(x_read_i)
+
+  while a non-initiating or unmatched worker's event is a solo local step
+  ``x_i <- x_i - eta_k * g_i(x_read_i)`` (its exchange happens passively
+  at its initiator's event). Then worker i re-reads
+  (``x_read_i <- x_i``) and starts its next gradient. ``eta_k`` follows
+  the worker's OWN step count k, so every worker walks the same LR
+  schedule the synchronous run walks per round, and per-round comms is
+  EXACTLY the synchronous one-peer schedule's (one exchange per matched
+  pair).
+
+Events are merged across workers by virtual finish time (ties broken by
+worker id, then step — stable, so the degenerate constant-latency schedule
+fires workers 0..N-1 in order at every tick). Over any window of N events
+every worker fires about once, so "round" comparisons against synchronous
+runs use N events per round: a horizon of T rounds is exactly N*T events,
+the same total gradient budget as T synchronous iterations.
+
+Why the degenerate case IS synchronous one-peer gossip: at constant
+latency every tick fires workers in id order, the initiator (pair min)
+fires before its partner, matchings are disjoint, and every gradient was
+read at the previous tick's boundary — so tick k realizes exactly
+``x' = 0.5 (I + P_k) x − η_k G(x)`` with G at the pre-mix models, the
+synchronous one-peer D-PSGD round on the identical matching draws
+(bench_async asserts the trajectories agree ≤ 1e-12 f64 under injected
+shared batches; the only difference left is XLA program shape).
+
+Why async wins wall-clock: synchronous round r costs ``max_i dur[r, i]``
+(``sync_round_times``) — the extreme value of N draws — while
+asynchronous progress is paced by each worker's OWN draws; the gap is the
+straggler tax, measured in ``examples/bench_async.py``
+(docs/perf/async.json).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from distributed_optimization_tpu.parallel.topology import Topology
+
+# Latency models for per-worker compute-time draws. All are normalized so
+# the MEAN duration is exactly ``latency_mean`` (the tail knob changes the
+# shape, never the mean — matched-mean by construction, so sync and async
+# runs burn the same expected compute per gradient step and the measured
+# gap is purely the barrier's straggler tax):
+# - 'constant':    every draw == latency_mean (the degenerate sync gate);
+# - 'exponential': Exp with mean latency_mean (memoryless jitter);
+# - 'lognormal':   exp(sigma Z - sigma^2/2) * latency_mean with
+#                  sigma = latency_tail (heavy upper tail for sigma >~ 1);
+# - 'pareto':      Pareto(alpha = latency_tail > 1) scaled to the mean
+#                  (the extreme-tail stress case; alpha <= 1 has no mean).
+LATENCY_MODELS = ("constant", "exponential", "lognormal", "pareto")
+
+# Derivation tag for the duration stream. Drawing [horizon, N] row-major
+# from a dedicated Generator keeps the timeline PREFIX-STABLE in the
+# horizon: the first H rounds of a longer build are bit-identical to a
+# shorter build's — the same contract build_fault_timeline gets from
+# per-t fold_in keys. (Matchings use the synchronous one-peer sampler's
+# jax key stream verbatim — see ``_round_matchings`` — so the degenerate
+# constant-latency schedule realizes the IDENTICAL pairings a sync
+# one_peer run realizes.)
+_DURATION_TAG = 0xE7D7
+
+
+@dataclasses.dataclass(frozen=True)
+class EventTimeline:
+    """Precomputed, totally ordered asynchronous event schedule (host arrays).
+
+    Pure function of (topology, horizon, seed, latency params) — see
+    ``build_event_timeline``. All per-event arrays are indexed by the
+    global event order; ``durations`` keeps the raw [horizon, N] draws so
+    synchronous wall-clock twins (``sync_round_times``) price the SAME
+    realization.
+    """
+
+    n_workers: int
+    n_rounds: int            # per-worker gradient steps (the horizon, T)
+    latency_model: str
+    latency_mean: float
+    latency_tail: float
+    worker: np.ndarray       # [E] int32 firing worker, E = N * T
+    partner: np.ndarray      # [E] int32 gossip partner (== worker: solo)
+    local_step: np.ndarray   # [E] int32 firing worker's own step index k
+    t_virtual: np.ndarray    # [E] float64 event times, nondecreasing
+    staleness: np.ndarray    # [E] int32 writes to row i between read & fire
+    durations: np.ndarray    # [T, N] float64 per-(round, worker) draws
+
+    @property
+    def n_events(self) -> int:
+        return self.worker.shape[0]
+
+    def matched(self) -> np.ndarray:
+        """[E] bool — initiator events, each realizing ONE pairwise
+        exchange (2·d floats); non-initiator/unmatched events are solo
+        local steps and move nothing. Per round the matched count is the
+        round's matching size — exactly the synchronous one-peer comms
+        budget."""
+        return self.partner != self.worker
+
+    def worker_clocks(self) -> np.ndarray:
+        """[N] float64 per-worker final virtual clocks — Σ of each
+        worker's own durations (passive participations cost nothing)."""
+        return self.durations.sum(axis=0)
+
+
+def _uniforms(seed: int, tag: int, horizon: int, n: int) -> np.ndarray:
+    """[horizon, n] float64 open-interval uniforms from a dedicated
+    counter-style stream. Row-major fill from a per-purpose Generator
+    makes each stream prefix-stable in the horizon; nextafter keeps draws
+    strictly inside (0, 1) so every inverse-CDF below is finite."""
+    rng = np.random.default_rng([seed & 0xFFFFFFFF, tag])
+    u = rng.random((horizon, n))
+    return np.clip(u, np.nextafter(0.0, 1.0), np.nextafter(1.0, 0.0))
+
+
+def sample_durations(
+    horizon: int,
+    n: int,
+    seed: int,
+    *,
+    latency_model: str,
+    latency_mean: float,
+    latency_tail: float,
+) -> np.ndarray:
+    """[horizon, n] float64 compute-time draws, mean == latency_mean.
+
+    Every model is realized by an explicit inverse-CDF over exactly one
+    (lognormal: two, Box-Muller) uniform per cell, so the draw count per
+    cell is fixed and the stream stays prefix-stable — numpy's ziggurat
+    samplers consume a data-dependent number of uniforms and would break
+    that contract.
+    """
+    if horizon <= 0:
+        raise ValueError(f"event horizon must be positive, got {horizon}")
+    if latency_mean <= 0.0:
+        raise ValueError(
+            f"latency_mean must be positive, got {latency_mean}"
+        )
+    if latency_model == "constant":
+        return np.full((horizon, n), float(latency_mean))
+    u = _uniforms(seed, _DURATION_TAG, horizon, n)
+    if latency_model == "exponential":
+        return -latency_mean * np.log1p(-u)
+    if latency_model == "lognormal":
+        sigma = float(latency_tail)
+        if sigma <= 0.0:
+            raise ValueError(
+                "latency_model='lognormal' needs latency_tail > 0 "
+                f"(the log-std tail knob), got {latency_tail}"
+            )
+        u2 = _uniforms(seed, _DURATION_TAG + 1, horizon, n)
+        z = np.sqrt(-2.0 * np.log(u)) * np.cos(2.0 * np.pi * u2)
+        return latency_mean * np.exp(sigma * z - 0.5 * sigma * sigma)
+    if latency_model == "pareto":
+        alpha = float(latency_tail)
+        if alpha <= 1.0:
+            raise ValueError(
+                "latency_model='pareto' needs latency_tail > 1 (the "
+                f"shape alpha; alpha <= 1 has no finite mean), got "
+                f"{latency_tail}"
+            )
+        x_m = latency_mean * (alpha - 1.0) / alpha
+        return x_m / np.power(u, 1.0 / alpha)
+    raise ValueError(
+        f"Unknown latency model: {latency_model!r}; known: {LATENCY_MODELS}"
+    )
+
+
+def _round_matchings(topo: Topology, horizon: int, seed: int) -> np.ndarray:
+    """[horizon, N] per-round partner involutions P_k — the EXACT draws the
+    synchronous one-peer schedule realizes.
+
+    Precomputed host-side through the same sampler and key stream
+    (``sample_one_peer_matching`` under ``fold_in(key(seed), 0x3A7C4)``,
+    the match-key tag ``make_faulty_mixing`` derives), the
+    build_fault_timeline convention: schedules may be unrolled with jax,
+    math twins stay independent. Per-t fold_in keys make the array
+    prefix-stable in the horizon.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_optimization_tpu.parallel.faults import (
+        sample_one_peer_matching,
+    )
+
+    if topo.is_matrix_free:
+        # Unreachable from the shipped async path (config rejects
+        # execution='async' with topology_impl='neighbor'); densifying
+        # the table here would silently allocate the [N, N] object the
+        # matrix-free representation exists to avoid — refuse instead.
+        raise ValueError(
+            "event timelines sample one-peer matchings from the dense "
+            "adjacency; build the topology with impl='dense' (the event "
+            "scan's regime is modest N, not the matrix-free axis)"
+        )
+    A = np.asarray(topo.adjacency, dtype=np.float32)
+    A_dev = jnp.asarray(A)
+    match_key = jax.random.fold_in(jax.random.key(seed), 0x3A7C4)
+
+    def one(t):
+        return sample_one_peer_matching(
+            jax.random.fold_in(match_key, t), A_dev
+        )
+
+    batched = jax.jit(jax.vmap(one))
+    # Chunk the vmap so the per-t (N, N) score draws never materialize as
+    # one [horizon, N, N] tensor (at N = 1024 and a few thousand rounds
+    # that would be gigabytes of host allocation for draws the sync path
+    # streams one round at a time).
+    n_nodes = A.shape[0]
+    chunk = max(1, 2**22 // max(n_nodes * n_nodes, 1))
+    out = np.empty((horizon, n_nodes), dtype=np.int64)
+    for s in range(0, horizon, chunk):
+        e = min(s + chunk, horizon)
+        ts = jnp.arange(s, e, dtype=jnp.int32)
+        out[s:e] = np.asarray(batched(ts))
+    return out
+
+
+def build_event_timeline(
+    topo: Topology,
+    horizon: int,
+    seed: int,
+    *,
+    latency_model: str = "constant",
+    latency_mean: float = 1.0,
+    latency_tail: float = 0.0,
+) -> EventTimeline:
+    """Unroll the asynchronous execution into a static event schedule.
+
+    ``horizon`` counts per-worker gradient steps (rounds): the schedule
+    holds exactly ``horizon * N`` events. Pure in (topology, horizon,
+    seed, latency params) and prefix-stable in the horizon — the first
+    H rounds' draws of a longer build are bit-identical — so a resumed or
+    re-twinned run rebuilds the identical schedule from the config alone
+    (the ``build_fault_timeline`` contract).
+
+    The O(E) host pass below merges the per-worker event streams, assigns
+    each round's mutual matching to its initiator events, and replays the
+    write counts that define realized staleness. Directed topologies are
+    rejected: the pairwise average is a mutual exchange.
+    """
+    if topo.directed:
+        raise ValueError(
+            "asynchronous pairwise gossip is an undirected exchange; "
+            f"topology {topo.name!r} has one-way links"
+        )
+    n = topo.n
+    durations = sample_durations(
+        horizon, n, seed,
+        latency_model=latency_model, latency_mean=latency_mean,
+        latency_tail=latency_tail,
+    )
+    finish = np.cumsum(durations, axis=0)  # [T, N] worker i's event times
+
+    # Per-round mutual matchings, shared with the synchronous one-peer
+    # sampler; the pair's exchange rides on its INITIATOR's (pair min's)
+    # k-th event, so each matched pair exchanges exactly once per round —
+    # the one-peer comms budget — while non-initiators fire solo local
+    # steps at their own pace.
+    P = _round_matchings(topo, horizon, seed)
+    idx = np.arange(n, dtype=np.int64)[None, :]
+    initiates = (P != idx) & (idx < P)
+    partner_kn = np.where(initiates, P, idx)
+
+    # Global order: by virtual finish time, ties by worker id then step —
+    # stable and deterministic, so the constant-latency degenerate case
+    # fires workers 0..N-1 in id order at every tick.
+    step_f = np.repeat(np.arange(horizon, dtype=np.int64), n)
+    worker_f = np.tile(np.arange(n, dtype=np.int64), horizon)
+    time_f = finish.reshape(-1)
+    partner_f = partner_kn.reshape(-1)
+    order = np.lexsort((step_f, worker_f, time_f))
+
+    worker = worker_f[order].astype(np.int32)
+    partner = partner_f[order].astype(np.int32)
+    local_step = step_f[order].astype(np.int32)
+    t_virtual = time_f[order]
+
+    # Realized staleness: writes to the firing worker's row between its
+    # read (right after its previous own event) and this event. Row i is
+    # written only at its own events and at initiator events whose
+    # partner is i, so the staleness of i's k-th event is the count of
+    # PASSIVE writes strictly between consecutive own events. One stable
+    # grouping of own/passive event ids by row (O(E log E) total — never
+    # a per-row scan of the full [E] arrays) feeds a per-row
+    # searchsorted over small contiguous segments.
+    E_total = worker.shape[0]
+    staleness = np.zeros(E_total, dtype=np.int32)
+    o_order = np.argsort(worker, kind="stable")  # ascending ids per row
+    o_bounds = np.searchsorted(worker[o_order], np.arange(n + 1))
+    pas_ids = np.flatnonzero(partner != worker)
+    p_order = np.argsort(partner[pas_ids], kind="stable")
+    pas_sorted = pas_ids[p_order]
+    p_bounds = np.searchsorted(partner[pas_sorted], np.arange(n + 1))
+    for i in range(n):
+        own_idx = o_order[o_bounds[i]:o_bounds[i + 1]]
+        pas_idx = pas_sorted[p_bounds[i]:p_bounds[i + 1]]
+        before = np.searchsorted(pas_idx, own_idx)
+        staleness[own_idx] = np.diff(before, prepend=0).astype(np.int32)
+
+    return EventTimeline(
+        n_workers=n,
+        n_rounds=horizon,
+        latency_model=latency_model,
+        latency_mean=float(latency_mean),
+        latency_tail=float(latency_tail),
+        worker=worker,
+        partner=partner,
+        local_step=local_step,
+        t_virtual=t_virtual,
+        staleness=staleness,
+        durations=durations,
+    )
+
+
+def sync_round_times(timeline: EventTimeline) -> np.ndarray:
+    """[T] float64 cumulative virtual clock of the BULK-SYNCHRONOUS twin.
+
+    A synchronous round ends when its slowest worker finishes, so round r
+    costs ``max_i durations[r, i]`` — priced on the SAME latency draws the
+    asynchronous schedule consumed, which is what makes sync-vs-async
+    wall-clock-to-ε comparisons an apples-to-apples statement about the
+    barrier, not about the draw realization.
+    """
+    return np.cumsum(timeline.durations.max(axis=1))
+
+
+def staleness_histogram(
+    timeline: EventTimeline, max_bucket: int = 8, *, events=None,
+) -> dict:
+    """Realized-staleness summary: counts per staleness value (values
+    >= max_bucket collapsed into one tail bucket), plus mean and max —
+    the health_summary/RunTrace block (docs/ASYNC.md). ``events``: an
+    optional (start, stop) event window, so a continuation slice's
+    health describes the events it actually executed."""
+    sl = slice(*events) if events is not None else slice(None)
+    s = np.asarray(timeline.staleness[sl], dtype=np.int64)
+    buckets: dict[str, int] = {}
+    for v in range(max_bucket):
+        c = int(np.sum(s == v))
+        if c:
+            buckets[str(v)] = c
+    tail = int(np.sum(s >= max_bucket))
+    if tail:
+        buckets[f"{max_bucket}+"] = tail
+    return {
+        "buckets": buckets,
+        "mean": float(s.mean()) if s.size else 0.0,
+        "max": int(s.max()) if s.size else 0,
+    }
+
+
+def clock_skew(timeline: EventTimeline, *, rounds=None) -> dict:
+    """Per-worker virtual-clock spread at the horizon (or over an
+    optional (start, stop) ROUND window): the realized clock drift a
+    barrier would have flattened every round."""
+    if rounds is not None:
+        clocks = timeline.durations[slice(*rounds)].sum(axis=0)
+    else:
+        clocks = timeline.worker_clocks()
+    mean = float(clocks.mean())
+    return {
+        "mean": mean,
+        "min": float(clocks.min()),
+        "max": float(clocks.max()),
+        "rel_spread": float((clocks.max() - clocks.min()) / mean)
+        if mean > 0 else 0.0,
+    }

@@ -438,8 +438,6 @@ def build_fault_timeline(
     edge_up = None
     if edge_drop_prob > 0.0:
         edge_index = _edge_list(topo)
-        ei = jnp.asarray(edge_index[:, 0])
-        ej = jnp.asarray(edge_index[:, 1])
         p = edge_drop_prob
         if burst_len == 1.0:
             # State-independent thresholds — EXACTLY the iid comparison
@@ -451,10 +449,36 @@ def build_fault_timeline(
             t_stay = np.float32(1.0 - (1.0 - p) / burst_len)  # P(down|down)
             t_init = np.float32(p)                          # stationary
 
+        if topo.is_matrix_free:
+            # Matrix-free edge chains (ISSUE-9 satellite): draw ONE
+            # float32 uniform per edge per round — the dense path's
+            # (n, n) matrix draw IS the quadratic object this
+            # representation exists to avoid, so the matrix-free stream
+            # is a different (equally seed-pure) realization of the same
+            # chain; dense-vs-matrix-free parity tests inject one shared
+            # timeline rather than relying on shared draws.
+            n_edges = edge_index.shape[0]
+
+            def edge_draw(t):
+                return jax.random.uniform(
+                    jax.random.fold_in(fault_key, t), (n_edges,),
+                    dtype=jnp.float32,
+                )
+        else:
+            ei = jnp.asarray(edge_index[:, 0])
+            ej = jnp.asarray(edge_index[:, 1])
+
+            def edge_draw(t):
+                # The SAME symmetric (seed, t) matrix draw the on-the-fly
+                # iid sampler consumes, read at the edge entries — what
+                # makes burst_len=1 reduce bitwise to the memoryless path.
+                return jax.random.uniform(
+                    jax.random.fold_in(fault_key, t), (n, n),
+                    dtype=jnp.float32,
+                )[ei, ej]
+
         def edge_step(up_prev, t):
-            u = jax.random.uniform(
-                jax.random.fold_in(fault_key, t), (n, n), dtype=jnp.float32
-            )[ei, ej]
+            u = edge_draw(t)
             thresh = jnp.where(
                 t == 0, t_init, jnp.where(up_prev, t_enter, t_stay)
             )
@@ -778,10 +802,12 @@ def make_faulty_mixing(
     use_timeline = (
         burst_len >= 1.0 or churn_active or participation_active
         or timeline is not None
-        # Matrix-free node faults always route through the precomputed
+        # Matrix-free faults always route through the precomputed
         # timeline (iid stragglers' chains are bitwise the on-the-fly
-        # draws, so nothing changes semantically — one code path).
-        or (topo.is_matrix_free and strag_active)
+        # draws, and iid edge drops are the burst_len=1 point of the
+        # per-edge chains, so nothing changes semantically — one code
+        # path with no dense [N, N] draw anywhere).
+        or (topo.is_matrix_free and (strag_active or drop_active))
     )
     if use_timeline and timeline is None:
         if horizon is None:
@@ -800,17 +826,18 @@ def make_faulty_mixing(
         )
     if topo.is_matrix_free:
         # Matrix-free (neighbor-table-native) route: node-process faults
-        # only — participation sampling, iid stragglers, crash-recovery
-        # churn — realized entirely in gather form over the static
-        # [N, k_max] table; per-edge drop processes and matching
-        # schedules need the dense machinery and are rejected upstream
-        # (config validation) and here.
-        if drop_active or one_peer or topo.directed:
+        # (participation sampling, iid stragglers, crash-recovery churn)
+        # AND per-edge drop processes (iid / bursty Gilbert-Elliott
+        # chains, ISSUE-9 satellite) — all realized in gather form over
+        # the static [N, k_max] table, the [horizon, E] edge chains
+        # indexed through the (node, slot) → edge-id map. Matching
+        # schedules still need the dense adjacency (partner sampling is
+        # an [N, N] argmax) and are rejected upstream and here.
+        if one_peer or topo.directed:
             raise ValueError(
-                "matrix-free topologies support node-process faults only "
-                "(participation_rate / straggler_prob / mttf+mttr); edge "
-                "drops and matching schedules need the dense adjacency — "
-                "use topology_impl='dense'"
+                "matrix-free topologies support synchronous fault "
+                "processes only; matching schedules and directed graphs "
+                "need the dense adjacency — use topology_impl='dense'"
             )
         return _make_gather_faulty_mixing(
             topo, timeline, drop_prob=drop_prob,
@@ -1073,6 +1100,25 @@ def _make_gather_faulty_mixing(
         jnp.asarray(timeline.part_up)
         if timeline is not None and timeline.part_up is not None else None
     )
+    # Per-edge chains in gather form (ISSUE-9 satellite): the [horizon, E]
+    # liveness bits land on both endpoints' rows through the static
+    # (node, slot) → edge-id table — the same symmetric composition the
+    # dense path realizes by scattering A[ei, ej] = A[ej, ei] = up[e],
+    # with no [N, N] object anywhere.
+    edge_up_dev = None
+    slot_dev = None
+    if timeline is not None and timeline.edge_up is not None:
+        from distributed_optimization_tpu.parallel.topology import (
+            incident_edge_slots,
+        )
+
+        edge_up_dev = jnp.asarray(timeline.edge_up)
+        slot_dev = jnp.asarray(
+            incident_edge_slots(
+                topo.nbr_idx, topo.nbr_mask, timeline.edge_index
+            ),
+            dtype=jnp.int32,
+        )
 
     def active(t) -> jax.Array:
         if node_up_dev is None and part_up_dev is None:
@@ -1085,8 +1131,11 @@ def _make_gather_faulty_mixing(
         return m
 
     def live(t) -> jax.Array:
+        out = mask_dev
+        if edge_up_dev is not None:
+            out = out * edge_up_dev[t].astype(jnp.float32)[slot_dev]
         m = active(t)
-        return mask_dev * m[:, None] * m[nbr_dev]
+        return out * m[:, None] * m[nbr_dev]
 
     def _wshape(x: jax.Array):
         return (n, nbr_dev.shape[1]) + (1,) * (x.ndim - 1)
@@ -1135,13 +1184,37 @@ def _make_gather_faulty_mixing(
     def make_neighbor_liveness(nbr_idx: np.ndarray, nbr_mask: np.ndarray):
         # Same contract as the dense path's: live(t) over the CALLER's
         # tables (which, for a matrix-free topology, are the topology's
-        # own — there is exactly one table). Node composition only.
+        # own — there is exactly one table), composing the edge chains
+        # through the caller-table slot map plus the node availability.
         caller_nbr = jnp.asarray(nbr_idx, dtype=jnp.int32)
         caller_mask = jnp.asarray(nbr_mask, dtype=jnp.float32)
+        caller_slots = None
+        if timeline is not None and timeline.edge_up is not None:
+            if nbr_idx is topo.nbr_idx and nbr_mask is topo.nbr_mask:
+                # The usual case: the caller's tables ARE the topology's
+                # own (neighbor_tables_for on a matrix-free topology
+                # returns them verbatim) — reuse the slot map computed
+                # above instead of redoing the O(N·k_max) Python walk.
+                caller_slots = slot_dev
+            else:
+                from distributed_optimization_tpu.parallel.topology import (
+                    incident_edge_slots,
+                )
+
+                caller_slots = jnp.asarray(
+                    incident_edge_slots(
+                        np.asarray(nbr_idx), np.asarray(nbr_mask),
+                        timeline.edge_index,
+                    ),
+                    dtype=jnp.int32,
+                )
 
         def live_fn(t) -> jax.Array:
+            out = caller_mask
+            if caller_slots is not None:
+                out = out * edge_up_dev[t].astype(jnp.float32)[caller_slots]
             m = active(t)
-            return caller_mask * m[:, None] * m[caller_nbr]
+            return out * m[:, None] * m[caller_nbr]
 
         return live_fn
 

@@ -210,6 +210,24 @@ def _health_section(records) -> list[str]:
             )
         if h.get("clip_frac_mean"):
             parts.append(f"screened msgs {h['clip_frac_mean']:.1%}")
+        a = h.get("async")
+        if a is not None:
+            # Event-driven execution (docs/ASYNC.md): realized staleness,
+            # the virtual-clock spread a barrier would have flattened, and
+            # the straggler tax the barrier would have charged (sync twin
+            # priced on the same latency draws).
+            tax = (
+                a["sync_virtual_duration"] / a["virtual_duration"]
+                if a.get("virtual_duration") else float("nan")
+            )
+            parts.append(
+                f"async[{a['latency_model']}] staleness "
+                f"{a['staleness']['mean']:.2f} mean/"
+                f"{a['staleness']['max']} max, clock skew "
+                f"{a['virtual_clock']['rel_spread']:.1%}, sync tax "
+                f"{tax:.2f}x, {a['floats_per_virtual_second']:.4g} "
+                "floats/vs"
+            )
         comms = h.get("comms")
         if comms is not None:
             # Bytes moved per ITERATION (realized mean; both gossip

@@ -188,7 +188,11 @@ class Simulator:
             spectral_gap=result.history.spectral_gap,
         )
         health = None
-        if cfg.telemetry:
+        if cfg.telemetry or cfg.execution == "async":
+            # Async runs carry no in-scan trace buffers, but their health
+            # block (staleness histogram, virtual-clock skew, floats per
+            # virtual second) derives from the presampled event timeline
+            # — always available, so always surfaced (docs/ASYNC.md).
             from distributed_optimization_tpu.telemetry import health_summary
 
             health = health_summary(cfg, result.history)

@@ -408,7 +408,88 @@ def health_summary(config, history, *, serving: Optional[dict] = None) -> dict:
         )
     h["comms"] = comms_summary(config, history)
     h["windowed_connectivity"] = realized_bhat(config, topo=topo)
+    # Async block scoped to the rounds THIS history executed (a
+    # continuation slice's eval axis carries its global round window, so
+    # its health never mixes slice floats with full-schedule durations).
+    rounds = None
+    ev = np.asarray(getattr(history, "eval_iterations", []))
+    if ev.size:
+        rounds = (int(ev[0]) - config.eval_every, int(ev[-1]))
+    a = async_summary(config, rounds=rounds)
+    if a is not None:
+        # Floats per VIRTUAL second from the run's OWN realized
+        # accounting (the comms_summary convention) over the executed
+        # window's simulated duration — events have no shared round, so
+        # per-round accounting has the wrong denominator (docs/ASYNC.md).
+        total = getattr(history, "total_floats_transmitted", None)
+        a["floats_per_virtual_second"] = (
+            float(total) / a["virtual_duration"]
+            if total is not None and a["virtual_duration"] > 0 else 0.0
+        )
+        h["async"] = a
     return h
+
+
+def async_summary(config, *, rounds=None) -> Optional[dict]:
+    """Event-schedule health block for asynchronous runs (docs/ASYNC.md).
+
+    Reads the run's event timeline host-side — bitwise the schedule the
+    backends executed (``parallel/events.py`` is (seed, horizon)-pure, the
+    ``realized_bhat`` convention) — and derives what the execution mode is
+    ABOUT: the realized staleness histogram, the per-worker virtual-clock
+    skew a barrier would have flattened, and the schedule facts behind
+    the floats-per-VIRTUAL-second figure ``health_summary`` completes
+    from the run's own realized comms accounting (events have no shared
+    round, so per-round accounting is the wrong denominator).
+    ``sync_virtual_duration`` prices the bulk-synchronous twin on the
+    same latency draws — the ratio is the realized straggler tax.
+    ``rounds``: an optional (start, stop) global ROUND window — a
+    continuation slice describes only the events it executed. None for
+    synchronous configs.
+    """
+    if getattr(config, "execution", "sync") != "async":
+        return None
+    from distributed_optimization_tpu.backends.async_scan import timeline_for
+    from distributed_optimization_tpu.parallel.events import (
+        clock_skew,
+        staleness_histogram,
+        sync_round_times,
+    )
+
+    # Shares the backend's own cached build (timeline_for's LRU): the
+    # O(E) host unroll runs once per config, not once per consumer.
+    _, tl = timeline_for(config)
+    n = tl.n_workers
+    start_r, stop_r = (0, tl.n_rounds) if rounds is None else rounds
+    ev_window = (start_r * n, stop_r * n)
+    sl = slice(*ev_window)
+    # Virtual duration of the executed window: event times are global, so
+    # a slice's duration is the time between its boundary events.
+    t_start = float(tl.t_virtual[ev_window[0] - 1]) if ev_window[0] else 0.0
+    t_stop = (
+        float(tl.t_virtual[ev_window[1] - 1])
+        if ev_window[1] > ev_window[0] else t_start
+    )
+    svt = sync_round_times(tl)
+    s_start = float(svt[start_r - 1]) if start_r else 0.0
+    return {
+        "latency_model": config.latency_model,
+        "latency_mean": float(config.latency_mean),
+        "latency_tail": float(config.latency_tail),
+        "events": int(ev_window[1] - ev_window[0]),
+        # One pairwise exchange (2·d floats) per matched event; the
+        # absolute floats-per-virtual-second figure is completed by
+        # health_summary from the run's realized accounting — the
+        # trained dimension is the DATASET's (bias column included), not
+        # a config-derived guess.
+        "matched_events": int(tl.matched()[sl].sum()),
+        "staleness": staleness_histogram(tl, events=ev_window),
+        "virtual_clock": clock_skew(tl, rounds=(start_r, stop_r)),
+        "virtual_duration": t_stop - t_start,
+        "sync_virtual_duration": (
+            float(svt[stop_r - 1]) - s_start if stop_r > start_r else 0.0
+        ),
+    }
 
 
 def comms_summary(config, history) -> Optional[dict]:

@@ -29,6 +29,7 @@ python examples/bench_telemetry.py         # -> docs/perf/telemetry.json (overhe
 python examples/bench_fused_robust.py      # -> docs/perf/fused_robust.json (compiled-path floor gated)
 python examples/bench_serving.py           # -> docs/perf/serving.json (latency/throughput floors gated)
 python examples/bench_federated.py         # -> docs/perf/federated.json (floats-to-eps floor + N=10k completion gated)
+python examples/bench_async.py             # -> docs/perf/async.json (wall-clock-to-eps floors + degenerate sync gate)
 python examples/reproduce_report.py --json docs/perf/report_reproduction.json
 python examples/northstar_consensus.py --ring-full  # -> docs/perf/northstar_consensus.json
 python bench.py                            # headline JSON line (stdout)

@@ -1,0 +1,470 @@
+"""Event-driven asynchronous gossip tests (ISSUE 9 tentpole).
+
+Covers the precomputed event timeline (``parallel/events.py``: purity,
+prefix stability, ordering, staleness bookkeeping, latency models), the
+scan-over-events execution paths (jax ``backends/async_scan.py`` + the
+numpy per-event twin: injected-schedule parity ≤ 1e-12 f64,
+checkpoint-mid-schedule resume-exactness on both backends), the
+degenerate constant-latency behavior against synchronous one-peer gossip,
+the telemetry health block, and the config/dispatch rejections. The
+wall-clock-to-ε measurement lives in ``examples/bench_async.py``
+(docs/perf/async.json).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_optimization_tpu.backends import jax_backend, numpy_backend
+from distributed_optimization_tpu.backends.async_scan import (
+    run_async,
+    timeline_for,
+)
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.parallel import build_topology
+from distributed_optimization_tpu.parallel.events import (
+    build_event_timeline,
+    clock_skew,
+    sample_durations,
+    staleness_histogram,
+    sync_round_times,
+)
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+N = 8
+T = 40
+CFG = ExperimentConfig(
+    execution="async", n_workers=N, n_iterations=T, eval_every=10,
+    n_samples=400, n_features=12, n_informative_features=8,
+    local_batch_size=8, dtype="float64", problem_type="quadratic",
+    algorithm="dsgd", topology="ring",
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    ds = generate_synthetic_dataset(CFG)
+    _, f_opt = compute_reference_optimum(ds, CFG.reg_param)
+    return ds, f_opt
+
+
+def event_schedule(cfg, ds, seed=0):
+    """Fixed [E, b] per-event batch indices into the firing worker's shard
+    — the async twin of conftest.batch_schedule."""
+    _, tl = timeline_for(cfg)
+    sizes = [ds.shard(i)[0].shape[0] for i in range(cfg.n_workers)]
+    rng = np.random.default_rng(seed)
+    return np.stack([
+        rng.integers(0, sizes[int(w)], size=cfg.local_batch_size)
+        for w in tl.worker
+    ])
+
+
+# --- timeline properties ---------------------------------------------------
+
+
+def test_timeline_pure_and_prefix_stable():
+    topo = build_topology("ring", N)
+    kw = dict(latency_model="lognormal", latency_mean=2.0, latency_tail=1.0)
+    a = build_event_timeline(topo, T, 7, **kw)
+    b = build_event_timeline(topo, T, 7, **kw)
+    for f in ("worker", "partner", "local_step", "t_virtual", "staleness",
+              "durations"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+    # Prefix stability in the horizon: the first T rounds of a longer
+    # build are bit-identical draws (the build_fault_timeline contract).
+    c = build_event_timeline(topo, 2 * T, 7, **kw)
+    assert np.array_equal(c.durations[:T], a.durations)
+    # A different seed realizes a different schedule.
+    d = build_event_timeline(topo, T, 8, **kw)
+    assert not np.array_equal(d.t_virtual, a.t_virtual)
+
+
+def test_timeline_invariants():
+    topo = build_topology("ring", N)
+    tl = build_event_timeline(
+        topo, T, 3, latency_model="exponential", latency_mean=1.0,
+    )
+    assert tl.n_events == N * T
+    # Every worker fires exactly T events, in its own step order.
+    for i in range(N):
+        own = tl.local_step[tl.worker == i]
+        assert np.array_equal(own, np.arange(T)), i
+    # Event times are globally nondecreasing; matched (initiator) events
+    # pair graph neighbors with the initiator as the pair minimum, and
+    # each round's matched events form the round's one-peer matching.
+    assert np.all(np.diff(tl.t_virtual) >= 0)
+    A = np.asarray(topo.adjacency)
+    m = tl.matched()
+    assert m.any()
+    assert np.all(A[tl.worker[m], tl.partner[m]] == 1)
+    assert np.all(tl.worker[m] < tl.partner[m])
+    for k in range(0, T, 7):
+        rnd = m & (tl.local_step == k)
+        pairs = set(zip(tl.worker[rnd].tolist(), tl.partner[rnd].tolist()))
+        nodes = [v for p in pairs for v in p]
+        assert len(nodes) == len(set(nodes))  # disjoint matching
+    # The globally first event fired before anything could touch its row.
+    assert tl.staleness[0] == 0
+    # Staleness counts exactly the PASSIVE writes between a worker's
+    # reads: summed over a worker's events it equals its passive
+    # participations that fell inside read-fire windows — bounded by its
+    # total passive participations, and positive somewhere (exponential
+    # draws interleave events with probability ~1).
+    total_stale = 0
+    for i in range(N):
+        passive = int(np.sum((tl.partner == i) & (tl.worker != i)))
+        own_stale = int(tl.staleness[tl.worker == i].sum())
+        assert own_stale <= passive, i
+        total_stale += own_stale
+    assert total_stale > 0
+
+
+def test_constant_latency_degenerates_to_round_order():
+    topo = build_topology("ring", N)
+    tl = build_event_timeline(topo, T, 11, latency_mean=0.5)
+    # Workers fire in id order at every tick k*c — the deterministic
+    # tie-break the degenerate sync gate rests on.
+    assert np.array_equal(tl.worker, np.tile(np.arange(N), T))
+    assert np.array_equal(tl.local_step, np.repeat(np.arange(T), N))
+    assert np.allclose(tl.t_virtual, np.repeat(np.arange(1, T + 1) * 0.5, N))
+    # The synchronous twin's clock coincides: no straggler tax at
+    # constant latency.
+    assert np.allclose(sync_round_times(tl), np.arange(1, T + 1) * 0.5)
+    assert clock_skew(tl)["rel_spread"] == 0.0
+
+
+def test_latency_models_matched_mean_and_tails():
+    topo = build_topology("ring", 16)
+    draws = {}
+    for model, tail in [("constant", 0.0), ("exponential", 0.0),
+                        ("lognormal", 1.25), ("pareto", 1.3)]:
+        d = sample_durations(
+            4000, 16, 5, latency_model=model, latency_mean=2.0,
+            latency_tail=tail,
+        )
+        assert np.all(d > 0)
+        # Matched mean by construction (pareto's alpha=1.3 tail converges
+        # slowly — only sanity-bounded here).
+        if model == "pareto":
+            assert 1.0 < d.mean() < 4.0
+        else:
+            assert d.mean() == pytest.approx(2.0, rel=0.05), model
+        draws[model] = d
+    # Tail ordering: the heavy-tailed models realize far larger extremes
+    # at the same mean.
+    assert draws["lognormal"].max() > 5 * draws["exponential"].mean()
+    assert draws["pareto"].max() > draws["exponential"].max()
+    # Heavy tails are what create staleness + clock skew.
+    tl_h = build_event_timeline(
+        topo, 50, 5, latency_model="lognormal", latency_tail=1.25,
+    )
+    tl_c = build_event_timeline(topo, 50, 5)
+    assert staleness_histogram(tl_h)["max"] > staleness_histogram(
+        tl_c)["max"]
+    assert clock_skew(tl_h)["rel_spread"] > clock_skew(tl_c)["rel_spread"]
+    with pytest.raises(ValueError, match="latency_tail > 0"):
+        sample_durations(10, 4, 0, latency_model="lognormal",
+                         latency_mean=1.0, latency_tail=0.0)
+    with pytest.raises(ValueError, match="alpha"):
+        sample_durations(10, 4, 0, latency_model="pareto",
+                         latency_mean=1.0, latency_tail=1.0)
+    with pytest.raises(ValueError, match="Unknown latency model"):
+        sample_durations(10, 4, 0, latency_model="gamma",
+                         latency_mean=1.0, latency_tail=0.0)
+
+
+# --- backend parity --------------------------------------------------------
+
+
+def test_jax_vs_numpy_per_event_parity(setup):
+    """Injected per-event schedule ⇒ the two backends replay the identical
+    event sequence: state prefixes and metric rows agree ≤ 1e-12 f64."""
+    ds, f_opt = setup
+    cfg = CFG.replace(eval_every=1)  # a metric row every N events
+    sched = event_schedule(cfg, ds)
+    rj = jax_backend.run(cfg, ds, f_opt, batch_schedule=sched)
+    rn = numpy_backend.run(cfg, ds, f_opt, batch_schedule=sched)
+    assert np.max(np.abs(rj.final_models - rn.final_models)) < 1e-12
+    gap_dev = np.max(
+        np.abs(rj.history.objective - rn.history.objective)
+        / np.maximum(np.abs(rn.history.objective), 1.0)
+    )
+    assert gap_dev < 1e-12
+    assert np.allclose(
+        rj.history.consensus_error, rn.history.consensus_error,
+        rtol=1e-12, atol=1e-12,
+    )
+    # Identical comms accounting (2·d floats per matched event) and the
+    # round-based eval axis.
+    assert (
+        rj.history.total_floats_transmitted
+        == rn.history.total_floats_transmitted
+    )
+    assert np.array_equal(
+        rj.history.eval_iterations, rn.history.eval_iterations
+    )
+    # Per-event-granularity prefixes: state parity after 1 and 3 rounds.
+    for rounds in (1, 3):
+        pj = run_async(
+            cfg, ds, f_opt, batch_schedule=sched,
+            n_events=rounds * N, return_state=True, collect_metrics=False,
+        )
+        pn = numpy_backend.run_async(
+            cfg, ds, f_opt, batch_schedule=sched,
+            n_events=rounds * N, return_state=True, collect_metrics=False,
+        )
+        for k in ("x", "x_read"):
+            assert np.max(
+                np.abs(pj.final_state[k] - pn.final_state[k])
+            ) < 1e-12, (rounds, k)
+
+
+def test_resume_exactness_checkpoint_mid_schedule(setup, tmp_path):
+    """Satellite: checkpoint mid-schedule, restore from disk, and the tail
+    events replay bitwise on BOTH backends (the timeline and the
+    counter-based batch draws rebuild from the config alone)."""
+    ds, f_opt = setup
+    E = N * T
+    ckpt = tmp_path / "async_state.npz"
+    for runner in (run_async, numpy_backend.run_async):
+        full = runner(CFG, ds, f_opt, return_state=True)
+        half = runner(
+            CFG, ds, f_opt, n_events=E // 2, return_state=True,
+        )
+        np.savez(ckpt, **half.final_state)
+        restored = dict(np.load(ckpt))
+        tail = runner(
+            CFG, ds, f_opt, state0=restored, start_event=E // 2,
+            return_state=True,
+        )
+        for k in ("x", "x_read"):
+            assert np.array_equal(
+                tail.final_state[k], full.final_state[k]
+            ), (runner.__module__, k)
+        # The tail's metric rows are the full run's last rows, and the
+        # eval axis continues in global round numbering.
+        assert np.array_equal(
+            tail.history.objective, full.history.objective[2:]
+        )
+        assert np.array_equal(
+            tail.history.eval_iterations, full.history.eval_iterations[2:]
+        )
+    # A continuation slice's health block is scoped to ITS window: half
+    # the events, the slice's own virtual duration, and a floats/virtual-
+    # second rate consistent with the slice's realized accounting (never
+    # slice floats over the full schedule's clock).
+    from distributed_optimization_tpu.telemetry import health_summary
+
+    h_full = health_summary(CFG, full.history)["async"]
+    h_tail = health_summary(CFG, tail.history)["async"]
+    assert h_tail["events"] == E // 2 and h_full["events"] == E
+    assert h_tail["virtual_duration"] < h_full["virtual_duration"]
+    assert h_tail["floats_per_virtual_second"] == pytest.approx(
+        tail.history.total_floats_transmitted / h_tail["virtual_duration"]
+    )
+
+
+def test_misaligned_or_stateless_windows_rejected(setup):
+    ds, f_opt = setup
+    with pytest.raises(ValueError, match="align to eval boundaries"):
+        run_async(CFG, ds, f_opt, n_events=N * 5)
+    with pytest.raises(ValueError, match="needs the previous"):
+        run_async(CFG, ds, f_opt, start_event=N * CFG.eval_every)
+    with pytest.raises(ValueError, match="event rows"):
+        run_async(CFG, ds, f_opt, batch_schedule=np.zeros((7, 4), int))
+    with pytest.raises(ValueError, match="do not match the"):
+        run_async(
+            CFG, ds, f_opt,
+            state0={"x": np.zeros((N, 12))}, start_event=0,
+        )
+
+
+# --- behavior --------------------------------------------------------------
+
+
+def test_constant_latency_is_sync_one_peer(setup):
+    """The degenerate sync-reduction gate, exactly: at constant latency
+    the event schedule realizes ``x' = 0.5(I + P_t) x − η_t G(x)`` on the
+    IDENTICAL matching draws the synchronous one-peer path samples (same
+    sampler, same key stream), so with shared injected batches the two
+    runs agree ≤ 1e-12 f64 — the only remaining difference is XLA
+    program shape. Realized comms match exactly (one exchange per
+    matched pair per round)."""
+    from tests.conftest import batch_schedule
+
+    ds, f_opt = setup
+    Tg = 60
+    async_cfg = CFG.replace(n_iterations=Tg, eval_every=10)
+    sync_cfg = async_cfg.replace(
+        execution="sync", gossip_schedule="one_peer",
+        latency_mean=1.0,
+    )
+    # One batch realization per (worker, round), shared: the sync path
+    # consumes it as [T, N, b] rows, the event path as the firing
+    # worker's [E, b] rows.
+    sync_sched = batch_schedule(ds, Tg, CFG.local_batch_size)
+    _, tl = timeline_for(async_cfg)
+    async_sched = sync_sched[tl.local_step, tl.worker]
+    ra = jax_backend.run(async_cfg, ds, f_opt, batch_schedule=async_sched)
+    rs = jax_backend.run(sync_cfg, ds, f_opt, batch_schedule=sync_sched)
+    assert np.max(np.abs(ra.final_models - rs.final_models)) < 1e-12
+    assert np.allclose(
+        ra.history.objective, rs.history.objective,
+        rtol=1e-12, atol=1e-9,
+    )
+    assert (
+        ra.history.total_floats_transmitted
+        == rs.history.total_floats_transmitted
+    )
+
+
+def test_async_converges_under_heavy_tail(setup):
+    ds, f_opt = setup
+    cfg = CFG.replace(
+        n_iterations=300, eval_every=50, latency_model="lognormal",
+        latency_tail=1.25,
+    )
+    r = jax_backend.run(cfg, ds, f_opt)
+    gaps = r.history.objective
+    assert np.all(np.isfinite(gaps))
+    # Real optimization progress. The mean-over-workers gap decays more
+    # slowly than a barriered run's per ROUND — heavy-tailed laggards drag
+    # the average — which is exactly why the headline comparison is
+    # wall-clock-to-ε on the virtual clock (bench_async), not iters-to-ε.
+    assert gaps[-1] < 0.25 * gaps[0]
+    assert r.history.iters_per_second > 0
+
+
+# --- telemetry / serving surfaces ------------------------------------------
+
+
+def test_health_summary_async_block(setup):
+    from distributed_optimization_tpu.telemetry import (
+        async_summary,
+        health_summary,
+    )
+
+    ds, f_opt = setup
+    cfg = CFG.replace(latency_model="lognormal", latency_tail=1.0)
+    r = jax_backend.run(cfg, ds, f_opt)
+    h = health_summary(cfg, r.history)
+    a = h["async"]
+    assert a["latency_model"] == "lognormal"
+    assert a["events"] == N * T
+    assert sum(a["staleness"]["buckets"].values()) == N * T
+    assert a["staleness"]["mean"] >= 0.0
+    assert a["virtual_clock"]["rel_spread"] > 0.0
+    # The barrier twin on the same draws can only be slower.
+    assert a["sync_virtual_duration"] >= a["virtual_duration"]
+    assert a["floats_per_virtual_second"] > 0.0
+    # Matched events bound: one exchange per event.
+    assert a["matched_events"] <= a["events"]
+    assert async_summary(CFG.replace(execution="sync")) is None
+    # Sync runs carry no async block.
+    assert "async" not in health_summary(
+        CFG.replace(execution="sync"), r.history
+    )
+
+
+def test_simulator_and_runtrace_carry_async_health(setup):
+    from distributed_optimization_tpu.simulator import Simulator
+
+    ds, _ = setup
+    sim = Simulator(CFG, dataset=ds)
+    rec = sim.run_one("async smoke", verbose=False)
+    assert rec.health is not None and "async" in rec.health
+    traces = sim.run_traces()
+    assert traces and traces[0].health["async"]["events"] == N * T
+    text = sim.report_numerical_results()
+    assert "async[constant]" in text
+
+
+def test_structural_hash_distinguishes_execution_fields():
+    """The serving cache/coalescer key must MISS across execution-mode and
+    latency-model variants: the event schedule is baked into the traced
+    program (ISSUE-9: 'all structural for the serving cache')."""
+    base = CFG.replace(execution="sync")
+    variants = [
+        CFG,
+        CFG.replace(latency_model="exponential"),
+        CFG.replace(latency_mean=2.0),
+        CFG.replace(latency_model="lognormal", latency_tail=1.0),
+    ]
+    hashes = {c.structural_hash() for c in [base] + variants}
+    assert len(hashes) == len(variants) + 1
+
+
+def test_executable_cache_hit_is_bitwise(setup):
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+
+    ds, f_opt = setup
+    cache = ExecutableCache()
+    r1 = jax_backend.run(CFG, ds, f_opt, executable_cache=cache)
+    r2 = jax_backend.run(CFG, ds, f_opt, executable_cache=cache)
+    assert cache.hits == 1
+    assert r2.history.compile_seconds == 0.0
+    assert np.array_equal(r1.final_models, r2.final_models)
+
+
+# --- rejections ------------------------------------------------------------
+
+
+def test_config_rejections():
+    ok = dict(execution="async")
+    for bad, match in [
+        (dict(algorithm="gradient_tracking"), "per-event form"),
+        (dict(edge_drop_prob=0.2), "stragglers as LATENCY"),
+        (dict(participation_rate=0.5), "stragglers as LATENCY"),
+        (dict(mttf=10.0, mttr=5.0), "stragglers as LATENCY"),
+        (dict(attack="sign_flip", n_byzantine=1), "pairwise exchange"),
+        (dict(aggregation="trimmed_mean", robust_b=1), "pairwise exchange"),
+        (dict(compression="top_k", compression_k=4, algorithm="dsgd"),
+         "compressed"),
+        (dict(local_steps=2), "round-based lever"),
+        (dict(replicas=2), "totally"),
+        (dict(gossip_schedule="one_peer"), "IS a gossip schedule"),
+        (dict(topology="directed_ring"), "one-way links"),
+        (dict(topology_impl="neighbor", n_workers=8192,
+              topology="ring"), "dense-"),
+        (dict(telemetry=True), "no in-scan trace buffers"),
+        (dict(backend="cpp"), "cpp backend"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            ExperimentConfig(**{**ok, **bad})
+    # latency knobs are async-only; tail knobs are model-specific.
+    with pytest.raises(ValueError, match="silently ignore"):
+        ExperimentConfig(latency_tail=1.0)
+    with pytest.raises(ValueError, match="silently ignore"):
+        ExperimentConfig(latency_mean=3.0)
+    with pytest.raises(ValueError, match="latency_tail only shapes"):
+        ExperimentConfig(
+            execution="async", latency_model="exponential",
+            latency_tail=1.0,
+        )
+
+
+def test_runner_rejections(setup):
+    ds, f_opt = setup
+    from distributed_optimization_tpu.utils.checkpoint import (
+        CheckpointOptions,
+    )
+
+    with pytest.raises(ValueError, match="round-chunked checkpoint"):
+        jax_backend.run(
+            CFG, ds, f_opt,
+            checkpoint=CheckpointOptions(directory="/tmp/nope"),
+        )
+    with pytest.raises(ValueError, match="VIRTUAL clock"):
+        jax_backend.run(CFG, ds, f_opt, measure_timestamps=True)
+    with pytest.raises(ValueError, match="run seeds sequentially"):
+        jax_backend.run_batch(CFG, ds, f_opt, seeds=[1, 2])
+    assert jax_backend.batch_unsupported_reason(CFG) is not None
+
+
+def test_auto_topology_impl_stays_dense_for_async():
+    cfg = ExperimentConfig(
+        execution="async", n_workers=8192, topology="ring",
+        local_batch_size=4, n_samples=16384,
+    )
+    assert cfg.resolved_topology_impl() == "dense"

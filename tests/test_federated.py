@@ -400,13 +400,20 @@ def test_rejections():
         make_cfg(topology="fully_connected", topology_impl="neighbor")
     with pytest.raises(ValueError, match="jax"):
         make_cfg(topology_impl="neighbor", backend="numpy")
-    with pytest.raises(ValueError, match="Byzantine"):
+    # ISSUE-9 satellites: Byzantine screening and per-edge fault processes
+    # are ACCEPTED on the matrix-free path now (gather form / [horizon, E]
+    # chains through the slot table — tests/test_matrix_free_faults.py);
+    # only the [N, N]-materializing robust execution forms stay rejected.
+    make_cfg(
+        topology_impl="neighbor", attack="sign_flip", n_byzantine=1,
+        aggregation="trimmed_mean", robust_b=1,
+    )
+    make_cfg(topology_impl="neighbor", edge_drop_prob=0.1)
+    with pytest.raises(ValueError, match="gather form"):
         make_cfg(
-            topology_impl="neighbor", attack="sign_flip", n_byzantine=1,
-            aggregation="trimmed_mean", robust_b=1,
+            topology_impl="neighbor", aggregation="trimmed_mean",
+            robust_b=1, robust_impl="dense",
         )
-    with pytest.raises(ValueError, match="dense"):
-        make_cfg(topology_impl="neighbor", edge_drop_prob=0.1)
     with pytest.raises(ValueError, match="matrices|mixing"):
         make_cfg(topology_impl="neighbor", mixing_impl="dense")
 
@@ -468,22 +475,29 @@ def test_mixing_auto_keeps_dense_for_high_degree_graphs():
     assert make_mixing_op(star).impl == "dense"
 
 
-def test_batch_edge_sweep_resolves_dense():
-    """A swept edge_drop axis is a dense-only feature: the per-replica
-    configs (base edge_drop 0, positive per replica) resolve 'dense' even
-    where the base config alone would auto-resolve 'neighbor' — the
-    resolution _run_batch now consults (regression)."""
+def test_batch_edge_sweep_resolution_is_consistent():
+    """The per-replica configs of a swept edge_drop axis resolve to the
+    SAME representation the base config resolves to — since ISSUE-9 the
+    neighbor path carries per-edge fault processes, so the edge sweep no
+    longer forks replicas onto a different program than their sequential
+    twins (the invariant _run_batch's resolution consult protects)."""
     big = dict(BASE, n_workers=MATRIX_FREE_AUTO_N, topology="erdos_renyi")
     base_cfg = ExperimentConfig(**big)
     assert base_cfg.resolved_topology_impl() == "neighbor"
     rep = base_cfg.replace(edge_drop_prob=0.05)  # what each replica runs
-    assert rep.resolved_topology_impl() == "dense"
+    assert rep.resolved_topology_impl() == "neighbor"
 
 
 def test_auto_stays_dense_for_dense_only_features():
     big = dict(BASE, n_workers=MATRIX_FREE_AUTO_N)
+    # Edge-fault processes are matrix-free-capable since ISSUE-9: auto
+    # keeps the neighbor route (the N >= 10k bursty-link headroom).
     assert ExperimentConfig(
         **big, edge_drop_prob=0.1
+    ).resolved_topology_impl() == "neighbor"
+    # Byzantine screening runs matrix-free but stays an explicit opt-in.
+    assert ExperimentConfig(
+        **big, aggregation="trimmed_mean", robust_b=1,
     ).resolved_topology_impl() == "dense"
     assert ExperimentConfig(
         **big, backend="numpy"
