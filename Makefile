@@ -3,9 +3,11 @@
 
 PY ?= python
 
-.PHONY: test smoke serve-smoke bench-byzantine bench-churn \
+.PHONY: test smoke serve-smoke observatory-smoke perf-diff \
+	bench-byzantine bench-churn \
 	bench-robust-scale bench-sweep bench-compute bench-telemetry \
-	bench-fused bench-serving bench-federated bench-async
+	bench-fused bench-serving bench-federated bench-async \
+	bench-observatory
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -13,9 +15,11 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 
 # Fast robustness smoke: fault-injection + churn + Byzantine + gather-
-# aggregation + replica-batched-parity + telemetry + serving suites,
-# first failure stops, strict collection (no marker typos, no swallowed
-# import errors).
+# aggregation + replica-batched-parity + telemetry + serving +
+# observatory suites, first failure stops, strict collection (no marker
+# typos, no swallowed import errors); then the end-to-end observatory
+# smoke (daemon up -> run -> scrape /metrics -> stream progress ->
+# observatory compare + perf-diff self-check) over real HTTP.
 smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest -q -m 'not slow' -x \
 		tests/test_faults.py tests/test_churn.py tests/test_byzantine.py \
@@ -23,7 +27,27 @@ smoke:
 		tests/test_compressed_gossip.py tests/test_batch.py \
 		tests/test_telemetry.py tests/test_serving.py \
 		tests/test_federated.py tests/test_async.py \
-		tests/test_matrix_free_faults.py
+		tests/test_matrix_free_faults.py tests/test_observatory.py
+	$(MAKE) observatory-smoke
+
+# End-to-end live-observatory smoke over real HTTP (docs/OBSERVABILITY.md):
+# boot the daemon, stream /v1/progress while a run executes, scrape
+# /metrics mid-run (consistent-histogram check), then drive the
+# observatory CLI (list/compare) over the served manifests and self-check
+# make perf-diff against the committed docs/perf tree.
+observatory-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/observatory_smoke.py
+
+# Perf-regression checker (ISSUE-10): re-check bench JSON in FRESH
+# against the committed docs/perf within per-artifact tolerances
+# (observability/observatory.py PERF_TOLERANCES; exit 1 on regression).
+# Default FRESH=docs/perf is the self-check; point FRESH at a regen
+# output directory to guard a new measurement session:
+#   bash examples/regen_perf_artifacts.sh && make perf-diff FRESH=docs/perf
+FRESH ?= docs/perf
+perf-diff:
+	$(PY) -m distributed_optimization_tpu.observatory perf-diff \
+		--fresh $(FRESH) --committed docs/perf
 
 # End-to-end serving smoke over real HTTP (docs/SERVING.md): boot the
 # daemon, submit 3 requests (2 structurally identical -> ONE compile via
@@ -92,3 +116,9 @@ bench-async:
 # container, mixed-workload replay stats, f64 parity re-check).
 bench-serving:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_serving.py
+
+# Regenerate the live-observatory evidence (docs/perf/observatory.json:
+# heartbeat-on vs off steady-state overhead <= 3% ceiling + off/on
+# bitwise gate, async-path cell, /metrics scrape p95 under load).
+bench-observatory:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_observatory.py

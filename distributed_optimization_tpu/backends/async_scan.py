@@ -116,6 +116,65 @@ def _validate_slice(config, E: int, start_event: int, n_events: Optional[int]):
     return n_events, events_per_eval
 
 
+def _async_progress_emitter(config, progress_cb, timeline, start_event):
+    """Heartbeat closure for the event path: realized staleness quantiles
+    over the executed window (the live form of the ``async_summary``
+    health block) ride every event, and the chunk's staleness slice is
+    bulk-observed into the process metrics registry
+    (``dopt_async_staleness`` / ``dopt_async_events_total``)."""
+    from distributed_optimization_tpu.log import get_logger
+    from distributed_optimization_tpu.observability.metrics_registry import (
+        metrics_registry,
+    )
+    from distributed_optimization_tpu.observability.progress import (
+        ProgressEvent,
+        progress_heartbeat_counter,
+    )
+
+    log = get_logger("progress")
+    counter = progress_heartbeat_counter()
+    reg = metrics_registry()
+    ev_total = reg.counter(
+        "dopt_async_events_total",
+        "Asynchronous gossip events executed",
+    )
+    stale_hist = reg.histogram(
+        "dopt_async_staleness",
+        "Realized per-event staleness (writes between read and fire)",
+        buckets=(0, 1, 2, 4, 8, 16, 32, 64),
+    )
+    E_total = timeline.n_events
+
+    def emit(events_done, rounds_done, gap, cons, elapsed, chunk_events):
+        lo = start_event + events_done - chunk_events
+        hi = start_event + events_done
+        window = np.asarray(
+            timeline.staleness[start_event:hi], dtype=np.float64
+        )
+        ev_total.inc(chunk_events)
+        stale_hist.observe_many(timeline.staleness[lo:hi])
+        ev = ProgressEvent(
+            kind="async",
+            iteration=int(rounds_done),
+            n_iterations=int(timeline.n_rounds),
+            wall_seconds=float(elapsed),
+            gap=gap,
+            consensus=cons,
+            event_index=int(hi),
+            n_events=int(E_total),
+            staleness_p50=float(np.percentile(window, 50)),
+            staleness_p90=float(np.percentile(window, 90)),
+            staleness_max=float(window.max()),
+        )
+        counter.inc()
+        try:
+            progress_cb(ev)
+        except Exception:  # observability never kills the run
+            log.exception("progress callback failed; continuing run")
+
+    return emit
+
+
 def run_async(
     config,
     dataset: HostDataset,
@@ -129,8 +188,17 @@ def run_async(
     start_event: int = 0,
     n_events: Optional[int] = None,
     executable_cache=None,
+    progress_cb=None,
+    progress_every: int = 1,
 ) -> BackendRunResult:
     """Run one asynchronous experiment (``config.execution == 'async'``).
+
+    ``progress_cb``/``progress_every`` (ISSUE-10): when set, the outer
+    scan over eval chunks runs as a host-driven loop over the SAME
+    compiled chunk body (one executable serves every chunk — the event
+    arrays are traced inputs), emitting one ``ProgressEvent`` per
+    ``progress_every`` eval chunks with live staleness quantiles over the
+    executed window. ``None`` changes nothing (one fused program).
 
     ``batch_schedule [E_total, b]`` injects fixed per-EVENT batch indices
     into the firing worker's shard (the oracle-equivalence convention —
@@ -152,6 +220,7 @@ def run_async(
             measure_compile=measure_compile, return_state=return_state,
             state0=state0, start_event=start_event, n_events=n_events,
             executable_cache=executable_cache,
+            progress_cb=progress_cb, progress_every=progress_every,
         )
 
 
@@ -168,7 +237,13 @@ def _run_async(
     start_event: int,
     n_events,
     executable_cache,
+    progress_cb=None,
+    progress_every: int = 1,
 ) -> BackendRunResult:
+    if progress_every < 1:
+        raise ValueError(
+            f"progress_every must be >= 1 eval-chunks, got {progress_every}"
+        )
     problem = get_problem(
         config.problem_type, huber_delta=config.huber_delta,
         n_classes=config.n_classes,
@@ -262,7 +337,7 @@ def _run_async(
         "ev": ev_chunks,
     }
 
-    def run_scan(state, data):
+    def make_chunk_body(data):
         X, y, n_valid = data["X"], data["y"], data["n_valid"]
 
         def event_grad(x_read_i, ev):
@@ -318,52 +393,131 @@ def _run_async(
                     )
             return carry, out
 
-        return jax.lax.scan(chunk_body, state, data["ev"])
+        return chunk_body
 
-    # AOT compile with the sequential path's cache convention: the event
-    # arrays and the carry are traced inputs, so the key only needs the
-    # full config hash + the window/schedule trace facts.
+    def run_scan(state, data):
+        return jax.lax.scan(make_chunk_body(data), state, data["ev"])
+
     exec_cache = resolve_cache(executable_cache)
-    cache_key = cached = None
-    if exec_cache is not None:
-        cache_key = sequential_cache_key(
-            config, f_opt, device_data,
-            schedule_signature=(
-                "async", start_event, n_events, state0 is not None,
-                sched_sig,
-            ),
-            collect_metrics=collect_metrics,
+    if progress_cb is not None:
+        # Progress streaming (ISSUE-10): host-driven loop over the SAME
+        # compiled chunk body — the event arrays are traced inputs, so ONE
+        # executable serves every chunk; a Python loop feeding carries
+        # executes the identical per-chunk computation the fused outer
+        # scan would (bitwise, asserted in tests/test_observatory.py).
+        emit = _async_progress_emitter(
+            config, progress_cb, timeline, start_event
         )
-        cached = exec_cache.get(cache_key)
-    if cached is not None:
-        compiled = cached.executable
-        compile_seconds = 0.0
-    else:
-        t0c = time.perf_counter()
-        with jax.default_matmul_precision(config.matmul_precision):
-            lowered = jax.jit(run_scan).lower(st0, data_args)
-            cost = cost_from_lowered(lowered)
-            compiled = lowered.compile()
-        cold_seconds = time.perf_counter() - t0c
-        compile_seconds = cold_seconds if measure_compile else 0.0
+
+        def chunk_once(state, data):
+            return make_chunk_body(data)(state, data["ev"])
+
+        cache_key = cached = None
         if exec_cache is not None:
-            exec_cache.put(
-                cache_key, compiled, cost=cost,
-                compile_seconds=cold_seconds,
+            cache_key = sequential_cache_key(
+                config, f_opt, device_data,
+                schedule_signature=(
+                    "async-progress", events_per_eval, sched_sig,
+                ),
+                collect_metrics=collect_metrics,
             )
+            cached = exec_cache.get(cache_key)
+        data_c = dict(data_args)
+        data_c["ev"] = {k: v[0] for k, v in ev_chunks.items()}
+        if cached is not None:
+            compiled = cached.executable
+            compile_seconds = 0.0
+        else:
+            t0c = time.perf_counter()
+            with jax.default_matmul_precision(config.matmul_precision):
+                lowered = jax.jit(chunk_once).lower(st0, data_c)
+                cost = cost_from_lowered(lowered)
+                compiled = lowered.compile()
+            cold_seconds = time.perf_counter() - t0c
+            compile_seconds = cold_seconds if measure_compile else 0.0
+            if exec_cache is not None:
+                exec_cache.put(
+                    cache_key, compiled, cost=cost,
+                    compile_seconds=cold_seconds,
+                )
 
-    t1 = time.perf_counter()
-    final_state, ys = compiled(st0, data_args)
-    final_state = jax.block_until_ready(final_state)
-    run_seconds = time.perf_counter() - t1
+        t1 = time.perf_counter()
+        state = st0
+        gap_list: list[float] = []
+        cons_list: list[float] = []
+        last_emit_chunk = 0
+        for c in range(n_evals):
+            data_c = dict(data_args)
+            data_c["ev"] = {k: v[c] for k, v in ev_chunks.items()}
+            state, out = compiled(state, data_c)
+            jax.block_until_ready(state)
+            if "gap" in out:
+                gap_list.append(float(out["gap"]))
+            if "cons" in out:
+                cons_list.append(float(out["cons"]))
+            if (c + 1) % progress_every == 0 or c + 1 == n_evals:
+                emit(
+                    (c + 1) * events_per_eval,
+                    start_round + (c + 1) * config.eval_every,
+                    gap_list[-1] if gap_list else None,
+                    cons_list[-1] if cons_list else None,
+                    time.perf_counter() - t1,
+                    (c + 1 - last_emit_chunk) * events_per_eval,
+                )
+                last_emit_chunk = c + 1
+        final_state = state
+        run_seconds = time.perf_counter() - t1
+        gap_hist = (
+            np.asarray(gap_list, dtype=np.float64)
+            if gap_list else np.full(n_evals, np.nan)
+        )
+        cons_hist = (
+            np.asarray(cons_list, dtype=np.float64) if cons_list else None
+        )
+    else:
+        # AOT compile with the sequential path's cache convention: the
+        # event arrays and the carry are traced inputs, so the key only
+        # needs the full config hash + the window/schedule trace facts.
+        cache_key = cached = None
+        if exec_cache is not None:
+            cache_key = sequential_cache_key(
+                config, f_opt, device_data,
+                schedule_signature=(
+                    "async", start_event, n_events, state0 is not None,
+                    sched_sig,
+                ),
+                collect_metrics=collect_metrics,
+            )
+            cached = exec_cache.get(cache_key)
+        if cached is not None:
+            compiled = cached.executable
+            compile_seconds = 0.0
+        else:
+            t0c = time.perf_counter()
+            with jax.default_matmul_precision(config.matmul_precision):
+                lowered = jax.jit(run_scan).lower(st0, data_args)
+                cost = cost_from_lowered(lowered)
+                compiled = lowered.compile()
+            cold_seconds = time.perf_counter() - t0c
+            compile_seconds = cold_seconds if measure_compile else 0.0
+            if exec_cache is not None:
+                exec_cache.put(
+                    cache_key, compiled, cost=cost,
+                    compile_seconds=cold_seconds,
+                )
 
-    gap_hist = (
-        np.asarray(ys["gap"], dtype=np.float64)
-        if "gap" in ys else np.full(n_evals, np.nan)
-    )
-    cons_hist = (
-        np.asarray(ys["cons"], dtype=np.float64) if "cons" in ys else None
-    )
+        t1 = time.perf_counter()
+        final_state, ys = compiled(st0, data_args)
+        final_state = jax.block_until_ready(final_state)
+        run_seconds = time.perf_counter() - t1
+
+        gap_hist = (
+            np.asarray(ys["gap"], dtype=np.float64)
+            if "gap" in ys else np.full(n_evals, np.nan)
+        )
+        cons_hist = (
+            np.asarray(ys["cons"], dtype=np.float64) if "cons" in ys else None
+        )
     # Comms accounting: every matched event moves one pairwise exchange —
     # both models cross the wire, 2·d floats (a solo event moves none).
     matched_slice = int(np.sum(timeline.matched()[sl]))

@@ -421,6 +421,28 @@ def build_parser() -> argparse.ArgumentParser:
                            "buffers + cost analysis; docs/OBSERVABILITY.md) "
                            "and write one schema-versioned RunTrace "
                            "manifest per run to OUT as JSONL")
+    diag.add_argument("--progress", action="store_true",
+                      help="stream live per-chunk heartbeats to stderr "
+                           "(iteration, wall seconds, current gap/"
+                           "consensus, live B-hat under faults, staleness "
+                           "quantiles on async runs). The fused scan then "
+                           "executes as segments split at eval "
+                           "boundaries — trajectories stay bitwise "
+                           "identical (docs/OBSERVABILITY.md); jax "
+                           "backend, tp=1")
+    diag.add_argument("--progress-every", type=int, default=1, metavar="K",
+                      help="heartbeat cadence in eval-chunks (K x "
+                           "eval_every iterations per heartbeat; "
+                           "default 1)")
+    diag.add_argument("--trace-out", metavar="PATH", default=None,
+                      help="write the span tracer's Chrome trace-event "
+                           "JSON (data_gen/oracle + per-run compile/run "
+                           "spans) to PATH — open in chrome://tracing or "
+                           "ui.perfetto.dev")
+    diag.add_argument("--metrics-out", metavar="PATH", default=None,
+                      help="dump the process metrics registry (Prometheus "
+                           "text format — the daemon's /metrics "
+                           "exposition) to PATH at exit")
 
     out = p.add_argument_group("output")
     out.add_argument("--plot", metavar="PATH", default=None,
@@ -617,6 +639,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             every_evals=args.checkpoint_every,
             resume=not args.no_resume,
         )
+    if args.progress:
+        if args.backend != "jax" or args.tp > 1:
+            # Heartbeats ride the jax scan's segmented execution; the
+            # numpy/cpp/TP paths have no chunked form to hook — warn and
+            # run without, rather than failing a script that toggles
+            # backends.
+            _log.warning(
+                "--progress streams from the jax backend's chunked "
+                "execution (tp=1); backend=%s tp=%d runs without "
+                "heartbeats", args.backend, args.tp,
+            )
+        else:
+            import sys
+
+            from distributed_optimization_tpu.observability.progress import (
+                format_progress_line,
+            )
+
+            def _print_progress(ev):
+                print(format_progress_line(ev), file=sys.stderr, flush=True)
+
+            run_kwargs["progress_cb"] = _print_progress
+            run_kwargs["progress_every"] = args.progress_every
     if args.measure_time is not None:
         if args.backend == "jax":
             run_kwargs["measure_timestamps"] = args.measure_time
@@ -676,6 +721,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         _log.info("results saved to %s", args.json)
     if args.telemetry:
         sim.write_telemetry(args.telemetry)
+    if args.trace_out:
+        sim.write_chrome_trace(args.trace_out)
+    if args.metrics_out:
+        from pathlib import Path
+
+        Path(args.metrics_out).write_text(sim.metrics_text())
+        _log.info("metrics dumped to %s", args.metrics_out)
     return 0
 
 

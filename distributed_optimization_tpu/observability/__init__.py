@@ -1,0 +1,40 @@
+"""The live observatory (ISSUE-10): in-flight observability for a system
+whose runs are otherwise single opaque XLA programs.
+
+PR 5's flight recorder is post-hoc — ``RunTrace`` manifests appear only
+after a run completes — and the serving daemon plus the async execution
+path are operationally blind while work is in flight. This package is the
+live layer on top of it:
+
+- ``progress``   — per-chunk heartbeats from the executing backends (host
+  callbacks at chunk boundaries; bitwise-free when off), plus the bounded
+  pub/sub stream the daemon's ``/v1/progress/<id>`` channel reads.
+- ``metrics_registry`` — a small process-wide counter/gauge/histogram
+  registry the existing counters (executable cache, coalescer, async
+  staleness, phase timers) feed into, exported in Prometheus text format
+  at the daemon's ``/metrics`` and dumpable via the ``Simulator``.
+- ``spans``      — hierarchical span tracing (request → cohort → compile →
+  run → chunk) replacing the flat ``PhaseTimer``, with Chrome trace-event
+  JSON export (chrome://tracing / Perfetto).
+- ``observatory`` — the run registry + perf-regression CLI: index
+  RunTrace/manifest sidecars into a queryable store, compare runs, and
+  re-check regenerated bench JSON against the committed ``docs/perf/*``
+  within per-artifact tolerances (``make perf-diff``).
+
+Everything here is observability: no module in this package may change an
+optimization trajectory (tests assert progress/metrics on ⇒ bitwise the
+off trajectories).
+"""
+
+from distributed_optimization_tpu.observability.metrics_registry import (  # noqa: F401
+    MetricsRegistry,
+    metrics_registry,
+)
+from distributed_optimization_tpu.observability.progress import (  # noqa: F401
+    ProgressEvent,
+    ProgressStream,
+    format_progress_line,
+)
+from distributed_optimization_tpu.observability.spans import (  # noqa: F401
+    Tracer,
+)

@@ -1,0 +1,335 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+The serving subsystem already counts everything that matters — executable-
+cache hits/misses/evictions and compile-seconds-saved
+(``serving/cache.py``), coalescer cohort sizes and queue depth
+(``serving/service.py``), async events and staleness (``parallel/
+events.py`` via the telemetry health block), phase timers — but each
+counter lives in its own object with its own ad-hoc ``stats()`` dict.
+This module is the one place they all land so one scrape sees the whole
+process: a small counter/gauge/histogram registry rendered in the
+Prometheus text exposition format (v0.0.4) at the daemon's ``/metrics``
+endpoint and dumpable via ``Simulator.metrics_text()``.
+
+Design constraints (and why, not how):
+
+- **Consistent snapshots.** A scrape mid-run must never observe a torn
+  histogram (bucket counts that do not sum to ``_count``, or a ``_sum``
+  from a different moment than the buckets). Every mutation AND every
+  read of a metric family goes through the registry's one lock;
+  ``render()``/``snapshot()`` copy all values under it, so the exposition
+  is a point-in-time cut of the whole registry (tests hammer observes
+  from threads while scraping and assert the invariant).
+- **Get-or-create.** ``counter(name)`` returns the existing family when
+  one is registered — instrumented modules (cache, service) can be
+  constructed many times per process (tests, scoped caches) without
+  duplicate-registration errors; their increments accumulate into the
+  same family.
+- **Callback gauges.** Values that are someone else's source of truth
+  (queue depth, cache entry count) register a read callback instead of
+  pushing on every change — the registry polls them at scrape time, so
+  they can never go stale or drift from the owner.
+- **Stdlib only** (the serving daemon's constraint), and jax-free at
+  import time like ``config.py``/``telemetry.py``.
+
+Metric names follow the Prometheus conventions: ``dopt_`` prefix,
+``_total`` suffix on counters, base-unit names (seconds, bytes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+# Default histogram buckets: latency-ish spread (seconds) that also works
+# for small counts (cohort sizes, staleness). Families can override.
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+_VALID_KINDS = ("counter", "gauge", "histogram")
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _format_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    # Prometheus wants plain floats; integers print without the trailing
+    # '.0' noise so counter series stay readable.
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+class _Family:
+    """One metric family (name + help + kind) with per-label-set values.
+
+    All mutation happens under the owning registry's lock — the family
+    itself has none; it is never shared across registries.
+    """
+
+    def __init__(self, registry, name, help_text, kind, buckets=None):
+        self._registry = registry
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self.buckets = tuple(buckets) if buckets else DEFAULT_BUCKETS
+        # label-key -> float (counter/gauge) or
+        # label-key -> [bucket_counts list, sum, count] (histogram)
+        self._values: dict = {}
+        self._callback: Optional[Callable[[], float]] = None
+
+    # ------------------------------------------------------------ mutation
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if self.kind != "counter":
+            raise TypeError(f"{self.name} is a {self.kind}, not a counter")
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        key = _label_key(labels)
+        with self._registry._lock:
+            self._values[key] = self._values.get(key, 0.0) + float(amount)
+
+    def set(self, value: float, **labels) -> None:
+        if self.kind != "gauge":
+            raise TypeError(f"{self.name} is a {self.kind}, not a gauge")
+        key = _label_key(labels)
+        with self._registry._lock:
+            self._values[key] = float(value)
+
+    def observe(self, value: float, **labels) -> None:
+        if self.kind != "histogram":
+            raise TypeError(f"{self.name} is a {self.kind}, not a histogram")
+        key = _label_key(labels)
+        v = float(value)
+        with self._registry._lock:
+            cell = self._values.get(key)
+            if cell is None:
+                cell = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._values[key] = cell
+            counts, _, _ = cell
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1  # +Inf bucket
+            cell[1] += v
+            cell[2] += 1
+
+    def observe_many(self, values: Sequence[float], **labels) -> None:
+        """Bulk-observe under ONE lock acquisition (e.g. a finished run's
+        whole staleness series) — cheaper and atomically visible."""
+        if self.kind != "histogram":
+            raise TypeError(f"{self.name} is a {self.kind}, not a histogram")
+        key = _label_key(labels)
+        with self._registry._lock:
+            cell = self._values.get(key)
+            if cell is None:
+                cell = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._values[key] = cell
+            counts = cell[0]
+            for value in values:
+                v = float(value)
+                for i, le in enumerate(self.buckets):
+                    if v <= le:
+                        counts[i] += 1
+                        break
+                else:
+                    counts[-1] += 1
+                cell[1] += v
+                cell[2] += 1
+
+    # ------------------------------------------------------------- reading
+    def value(self, **labels) -> float:
+        """Current scalar value (counter/gauge) — tests and status blocks."""
+        key = _label_key(labels)
+        with self._registry._lock:
+            if self.kind == "histogram":
+                cell = self._values.get(key)
+                return float(cell[2]) if cell else 0.0
+            if self._callback is not None:
+                return float(self._callback())
+            return float(self._values.get(key, 0.0))
+
+
+class MetricsRegistry:
+    """A set of metric families sharing one lock (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: dict[str, _Family] = {}
+
+    # ----------------------------------------------------------- families
+    def _family(self, name, help_text, kind, buckets=None) -> _Family:
+        if not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {fam.kind}, "
+                        f"cannot re-register as {kind}"
+                    )
+                return fam
+            fam = _Family(self, name, help_text, kind, buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_text: str = "") -> _Family:
+        return self._family(name, help_text, "counter")
+
+    def gauge(self, name: str, help_text: str = "") -> _Family:
+        return self._family(name, help_text, "gauge")
+
+    def histogram(
+        self, name: str, help_text: str = "", buckets=None
+    ) -> _Family:
+        return self._family(name, help_text, "histogram", buckets)
+
+    def gauge_fn(
+        self, name: str, help_text: str, fn: Callable[[], float]
+    ) -> _Family:
+        """A gauge whose value is read from ``fn`` at scrape time.
+
+        Re-registering REPLACES the callback: the newest owner (e.g. the
+        most recently constructed service) is the live source of truth.
+        """
+        fam = self._family(name, help_text, "gauge")
+        with self._lock:
+            fam._callback = fn
+        return fam
+
+    # ------------------------------------------------------------ reading
+    def snapshot(self) -> dict:
+        """Point-in-time copy of every family (JSON-safe), taken under the
+        registry lock — the no-torn-histogram guarantee."""
+        with self._lock:
+            out = {}
+            for name, fam in sorted(self._families.items()):
+                if fam.kind == "histogram":
+                    out[name] = {
+                        "kind": fam.kind,
+                        "buckets": list(fam.buckets),
+                        "series": {
+                            _format_labels(k) or "": {
+                                "bucket_counts": list(cell[0]),
+                                "sum": cell[1],
+                                "count": cell[2],
+                            }
+                            for k, cell in fam._values.items()
+                        },
+                    }
+                else:
+                    values = dict(fam._values)
+                    if fam._callback is not None:
+                        try:
+                            values[()] = float(fam._callback())
+                        except Exception:
+                            values.setdefault((), 0.0)
+                    out[name] = {
+                        "kind": fam.kind,
+                        "series": {
+                            _format_labels(k) or "": v
+                            for k, v in values.items()
+                        },
+                    }
+            return out
+
+    def render(self) -> str:
+        """Prometheus text exposition (v0.0.4) of the whole registry —
+        one consistent cut (see ``snapshot``)."""
+        lines: list[str] = []
+        with self._lock:
+            for name, fam in sorted(self._families.items()):
+                if fam.help:
+                    lines.append(f"# HELP {name} {fam.help}")
+                lines.append(f"# TYPE {name} {fam.kind}")
+                if fam.kind == "histogram":
+                    for key, cell in sorted(fam._values.items()):
+                        counts, total, count = cell
+                        cum = 0
+                        for i, le in enumerate(fam.buckets):
+                            cum += counts[i]
+                            lk = _format_labels(key + (("le", _fmt(le)),))
+                            lines.append(f"{name}_bucket{lk} {cum}")
+                        cum += counts[-1]
+                        lk = _format_labels(key + (("le", "+Inf"),))
+                        lines.append(f"{name}_bucket{lk} {cum}")
+                        ls = _format_labels(key)
+                        lines.append(f"{name}_sum{ls} {_fmt(total)}")
+                        lines.append(f"{name}_count{ls} {count}")
+                    if not fam._values:
+                        # An empty histogram still exposes its full zero
+                        # shape: bare _sum/_count without _bucket lines
+                        # is invalid exposition ("histogram has no
+                        # buckets") and strict scrapers reject the whole
+                        # payload — exactly in the cold-daemon state.
+                        for le in fam.buckets:
+                            lk = _format_labels((("le", _fmt(le)),))
+                            lines.append(f"{name}_bucket{lk} 0")
+                        lk = _format_labels((("le", "+Inf"),))
+                        lines.append(f"{name}_bucket{lk} 0")
+                        lines.append(f"{name}_sum 0")
+                        lines.append(f"{name}_count 0")
+                    continue
+                values = dict(fam._values)
+                if fam._callback is not None:
+                    try:
+                        values[()] = float(fam._callback())
+                    except Exception:
+                        values.setdefault((), 0.0)
+                if not values:
+                    values[()] = 0.0
+                for key, v in sorted(values.items()):
+                    lines.append(f"{name}{_format_labels(key)} {_fmt(v)}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Drop every family (tests only — production counters are
+        monotone for the whole process lifetime)."""
+        with self._lock:
+            self._families.clear()
+
+
+# ------------------------------------------------------ process-wide default
+
+_process_registry = MetricsRegistry()
+
+
+def metrics_registry() -> MetricsRegistry:
+    """The process-wide default registry: what the daemon's ``/metrics``
+    scrapes and ``Simulator.metrics_text()`` dumps. Instrumented modules
+    (``serving/cache.py``, ``serving/service.py``, the progress layer)
+    feed it by default; tests may construct scoped ``MetricsRegistry``
+    instances instead."""
+    return _process_registry
+
+
+def observe_phases(
+    phases: dict, registry: Optional[MetricsRegistry] = None
+) -> None:
+    """Fold a {phase: seconds} accounting delta into the registry's
+    ``dopt_phase_seconds_total`` counter family — the bridge from the
+    span/phase layer to the scrape surface."""
+    reg = registry if registry is not None else metrics_registry()
+    fam = reg.counter(
+        "dopt_phase_seconds_total",
+        "Wall-clock seconds spent per named phase (data_gen, oracle, "
+        "compile, run, ...)",
+    )
+    for name, secs in phases.items():
+        if secs > 0:
+            fam.inc(float(secs), phase=str(name))
+
+
+def now() -> float:
+    return time.time()

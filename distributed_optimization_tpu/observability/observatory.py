@@ -1,0 +1,595 @@
+"""The run registry + perf-regression checker (ISSUE-10 tentpole layer 4).
+
+The repo now emits schema-versioned run evidence everywhere — RunTrace
+JSONL from the CLI/Simulator/daemon, ``*.manifest.json`` provenance
+sidecars from every bench — but nothing could READ that corpus: finding
+"the runs of this config on this machine" meant grepping JSON by hand,
+and a regenerated bench artifact was only ever compared to its committed
+ancestor by eyeball. This module is the query side:
+
+- ``index``/``list``: walk a directory for RunTrace manifests (``.jsonl``
+  lines and bare ``.json`` objects) and bench sidecars, normalize each
+  into a flat record (kind, label, config/structural hash, platform,
+  provenance, final gap, iters/sec), filter by any of them, and emit a
+  table or JSON. The structural hash is recomputed from the embedded
+  config via ``ExperimentConfig.structural_hash`` — the SERVING cohort
+  identity, so "which runs would have coalesced" is a one-flag query.
+- ``compare A B``: field-level diff of two manifests — config fields
+  that differ, provenance drift (different commit? dirty tree? other
+  chip?), and the headline numbers side by side with ratios.
+- ``perf-diff``: the regression checker. Re-checks a directory of
+  freshly regenerated bench JSON against the committed ``docs/perf/*``
+  within PER-ARTIFACT tolerances (``PERF_TOLERANCES``): structural keys
+  must match exactly (the drift-guard contract), flagged booleans must
+  not regress, and the named numeric series must agree within each
+  entry's relative tolerance. Wall-clock-dependent numbers are NOT
+  checked by default — on a co-tenant machine they vary 2-3× between
+  sessions (docs/ROUND5_NOTES.md); the specs name the quantities that
+  are supposed to be stable (ratios, convergence envelopes, gate
+  booleans). Exit code 1 on any regression — ``make perf-diff`` wires it
+  into CI, turning the bench corpus into a guarded time series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import sys
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+# ---------------------------------------------------------------- indexing
+
+
+@dataclasses.dataclass
+class RunRecord:
+    """One indexed manifest (RunTrace or bench sidecar), flattened."""
+
+    path: str
+    line: Optional[int]  # JSONL line number (None for whole-file manifests)
+    kind: str
+    schema_version: int
+    label: str
+    backend: Optional[str]
+    platform: Optional[str]
+    config_hash: Optional[str]
+    structural_hash: Optional[str]
+    algorithm: Optional[str]
+    n_workers: Optional[int]
+    final_gap: Optional[float]
+    iters_per_second: Optional[float]
+    git_sha: Optional[str]
+    device_kind: Optional[str]
+
+    def row(self) -> str:
+        gap = (
+            f"{self.final_gap:.3e}" if self.final_gap is not None else "—"
+        )
+        ips = (
+            f"{self.iters_per_second:.1f}"
+            if self.iters_per_second is not None else "—"
+        )
+        sha = (self.git_sha or "—")[:8]
+        return (
+            f"{self.label[:32]:<34}{self.kind:<16}"
+            f"{(self.structural_hash or '—')[:12]:<14}"
+            f"{(self.algorithm or '—'):<18}{gap:>11}{ips:>9}  "
+            f"{(self.platform or '—'):<5} {sha}"
+        )
+
+
+_HEADER = (
+    f"{'label':<34}{'kind':<16}{'struct_hash':<14}{'algorithm':<18}"
+    f"{'final_gap':>11}{'iters/s':>9}  {'plat':<5} git"
+)
+
+
+def _structural_hash_of(config_dict) -> Optional[str]:
+    if not isinstance(config_dict, dict):
+        return None
+    try:
+        from distributed_optimization_tpu.config import ExperimentConfig
+
+        return ExperimentConfig.from_dict(config_dict).structural_hash()
+    except Exception:
+        # Configs from older schema versions may no longer validate;
+        # an indexer must degrade to "unknown", not crash the listing.
+        return None
+
+
+def _record_from_manifest(
+    blob: dict, path: Path, line: Optional[int]
+) -> Optional[RunRecord]:
+    kind = blob.get("kind")
+    if kind not in ("run_trace", "bench_manifest"):
+        return None
+    cfg = blob.get("config") or {}
+    health = blob.get("health") or {}
+    prov = blob.get("provenance") or {}
+    return RunRecord(
+        path=str(path),
+        line=line,
+        kind=kind,
+        schema_version=int(blob.get("schema_version", 0)),
+        label=str(blob.get("label") or blob.get("artifact") or path.stem),
+        backend=blob.get("backend"),
+        platform=blob.get("platform"),
+        config_hash=blob.get("config_hash"),
+        structural_hash=_structural_hash_of(cfg),
+        algorithm=cfg.get("algorithm") if isinstance(cfg, dict) else None,
+        n_workers=cfg.get("n_workers") if isinstance(cfg, dict) else None,
+        final_gap=_as_float(health.get("final_gap")),
+        iters_per_second=_as_float(blob.get("iters_per_second")),
+        git_sha=prov.get("git_sha"),
+        device_kind=prov.get("device_kind"),
+    )
+
+
+def _as_float(v) -> Optional[float]:
+    try:
+        return float(v) if v is not None and not isinstance(v, str) else None
+    except (TypeError, ValueError):
+        return None
+
+
+def iter_manifests(root) -> Iterator[tuple[dict, Path, Optional[int]]]:
+    """Yield (manifest dict, path, jsonl-line-or-None) for every readable
+    RunTrace/bench manifest under ``root`` (a file or a directory).
+    Unreadable or foreign JSON is skipped — an index walks what it can."""
+    from distributed_optimization_tpu.telemetry import _decode_nonfinite
+
+    root = Path(root)
+    paths = (
+        [root] if root.is_file()
+        else sorted(
+            p for pattern in ("*.json", "*.jsonl") for p in root.rglob(pattern)
+        )
+    )
+    for path in paths:
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        if path.suffix == ".jsonl":
+            for i, line in enumerate(text.splitlines()):
+                if not line.strip():
+                    continue
+                try:
+                    yield _decode_nonfinite(json.loads(line)), path, i
+                except json.JSONDecodeError:
+                    continue
+        else:
+            try:
+                yield _decode_nonfinite(json.loads(text)), path, None
+            except json.JSONDecodeError:
+                continue
+
+
+def build_index(root, **filters) -> list[RunRecord]:
+    """Index every manifest under ``root`` into ``RunRecord`` rows.
+
+    ``filters``: config_hash=, structural_hash=, backend=, platform=,
+    kind=, label= (substring, case-insensitive) — all ANDed.
+    """
+    records = []
+    for blob, path, line in iter_manifests(root):
+        if not isinstance(blob, dict):
+            continue
+        rec = _record_from_manifest(blob, path, line)
+        if rec is None:
+            continue
+        if _matches(rec, filters):
+            records.append(rec)
+    return records
+
+
+def _matches(rec: RunRecord, filters: dict) -> bool:
+    for key, want in filters.items():
+        if want is None:
+            continue
+        have = getattr(rec, key, None)
+        if key == "label":
+            if have is None or want.lower() not in have.lower():
+                return False
+        elif have != want:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------- compare
+
+
+def load_manifest(spec: str) -> dict:
+    """Load one manifest: ``path.json``, or ``path.jsonl[:line]`` (line 0
+    when omitted)."""
+    path, line = spec, 0
+    if ":" in spec and not Path(spec).exists():
+        path, _, line_s = spec.rpartition(":")
+        try:
+            line = int(line_s)
+        except ValueError:
+            path, line = spec, 0
+    from distributed_optimization_tpu.telemetry import _decode_nonfinite
+
+    p = Path(path)
+    text = p.read_text()
+    if p.suffix == ".jsonl":
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        return _decode_nonfinite(json.loads(lines[line]))
+    return _decode_nonfinite(json.loads(text))
+
+
+def compare_manifests(a: dict, b: dict) -> dict:
+    """Field-level diff of two manifests (the ``compare`` subcommand)."""
+    cfg_a, cfg_b = a.get("config") or {}, b.get("config") or {}
+    config_diff = {
+        k: [cfg_a.get(k), cfg_b.get(k)]
+        for k in sorted(set(cfg_a) | set(cfg_b))
+        if cfg_a.get(k) != cfg_b.get(k)
+    }
+    prov_a, prov_b = a.get("provenance") or {}, b.get("provenance") or {}
+    prov_diff = {
+        k: [prov_a.get(k), prov_b.get(k)]
+        for k in sorted(set(prov_a) | set(prov_b))
+        if prov_a.get(k) != prov_b.get(k)
+    }
+    ha, hb = a.get("health") or {}, b.get("health") or {}
+
+    def ratio(x, y):
+        x, y = _as_float(x), _as_float(y)
+        if x is None or y is None or x == 0:
+            return None
+        return y / x
+
+    headline = {}
+    for key, va, vb in (
+        ("final_gap", ha.get("final_gap"), hb.get("final_gap")),
+        ("iters_per_second", a.get("iters_per_second"),
+         b.get("iters_per_second")),
+        ("compile_seconds", a.get("compile_seconds"),
+         b.get("compile_seconds")),
+    ):
+        headline[key] = {"a": va, "b": vb, "b_over_a": ratio(va, vb)}
+    return {
+        "a": {"label": a.get("label") or a.get("artifact"),
+              "config_hash": a.get("config_hash")},
+        "b": {"label": b.get("label") or b.get("artifact"),
+              "config_hash": b.get("config_hash")},
+        "same_config_hash": (
+            a.get("config_hash") == b.get("config_hash")
+            and a.get("config_hash") is not None
+        ),
+        "structural_match": (
+            _structural_hash_of(cfg_a) == _structural_hash_of(cfg_b)
+            and _structural_hash_of(cfg_a) is not None
+        ),
+        "config_diff": config_diff,
+        "provenance_diff": prov_diff,
+        "headline": headline,
+    }
+
+
+# ------------------------------------------------------------- perf-diff
+
+
+@dataclasses.dataclass(frozen=True)
+class Check:
+    """One tolerance rule: dotted-path pattern (fnmatch, list indices are
+    path components) → how fresh may differ from committed.
+
+    ``rtol``: numeric leaves must satisfy |fresh − committed| ≤
+    rtol·max(|committed|, atol_floor). ``equal``: exact equality (gate
+    booleans, flags, counts); with ``bool_only`` the pattern's non-boolean
+    matches are skipped — the idiom for ``gates.*`` blocks that mix
+    asserted booleans with measured floats. ``direction``: 'min' fails a
+    fresh value only BELOW the envelope (throughput-style floors where
+    faster is fine), 'max' the mirror (overhead/deviation ceilings).
+    """
+
+    pattern: str
+    rtol: float = 0.25
+    equal: bool = False
+    bool_only: bool = False
+    direction: Optional[str] = None  # None | 'min' | 'max'
+    atol_floor: float = 1e-9
+
+
+# Per-artifact checks. Deliberately NOT exhaustive: bench JSON is full of
+# session-dependent wall-clock numbers that vary 2-3× between runs on this
+# shared machine (docs/ROUND5_NOTES.md) — checking those would make the
+# guard cry wolf. What IS checked: the gate booleans every bench asserts
+# (a regen that flips one has regressed — including platform-conditional
+# flags like ``floor_applied``, which correctly fail when "fresh" came
+# from different hardware: such a regen is not comparable evidence),
+# deterministic convergence facts (final gaps, B̂ tables, floats-to-ε)
+# inside generous envelopes, f64 parity ceilings, and the committed floor
+# constants themselves. Artifacts without an entry get the top-level
+# key-structure check only (the drift-guard parity).
+PERF_TOLERANCES: dict[str, tuple[Check, ...]] = {
+    "observatory.json": (
+        Check("gates.*", equal=True, bool_only=True),
+        Check("heartbeat.overhead_frac", rtol=1.0, direction="max",
+              atol_floor=0.03),
+        Check("scrape.p95_ms", rtol=3.0, direction="max", atol_floor=5.0),
+    ),
+    "telemetry.json": (
+        Check("gates.*", equal=True, bool_only=True),
+        Check("cells.*.overhead_ok", equal=True),
+        Check("cells.*.off_on_bitwise_objective", equal=True),
+    ),
+    "serving.json": (
+        Check("gates.applied", equal=True),
+        Check("parity.max_abs_deviation_f64", rtol=1.0, atol_floor=1e-12,
+              direction="max"),
+        Check("latency.speedup_submit_to_start", rtol=0.9, direction="min"),
+        Check("throughput.speedup", rtol=0.6, direction="min"),
+        Check("throughput.coalescing_loses", equal=True),
+    ),
+    "async.json": (
+        Check("gates.*", equal=True, bool_only=True),
+        Check("gates.jax_vs_numpy_per_event_parity_max_dev_f64",
+              rtol=1.0, atol_floor=1e-12, direction="max"),
+    ),
+    "federated.json": (
+        Check("gates.max_n_completed_matrix_free", equal=True),
+        Check("gates.best_floats_to_eps_reduction", rtol=0.5,
+              direction="min"),
+    ),
+    "fused_robust.json": (
+        Check("gates.*", equal=True, bool_only=True),
+        Check("gates.compiled_floor", equal=True),
+        Check("gates.bytes_ceiling", equal=True),
+        Check("gates.gap_envelope", equal=True),
+    ),
+    "churn.json": (
+        Check("gates.burst1_bitwise_iid", equal=True),
+        Check("gates.bhat_by_burst.*", equal=True),
+        Check("gates.monotone_gap_degradation.*", rtol=0.5),
+    ),
+    "sweep.json": (
+        Check("floors.accelerator_speedup_at_r32", equal=True),
+        Check("floors.cpu_steady_speedup_at_r32", equal=True),
+    ),
+}
+
+
+def _iter_leaves(obj, prefix=()) -> Iterator[tuple[tuple, Any]]:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield from _iter_leaves(v, prefix + (str(k),))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            yield from _iter_leaves(v, prefix + (str(i),))
+    else:
+        yield prefix, obj
+
+
+def _check_leaf(check: Check, path: str, committed, fresh) -> Optional[str]:
+    """None when within tolerance, else the failure message."""
+    if check.equal:
+        if fresh != committed:
+            return f"{path}: {committed!r} -> {fresh!r} (must match exactly)"
+        return None
+    c, f = _as_float(committed), _as_float(fresh)
+    if c is None or f is None:
+        if fresh != committed and (c is None) != (f is None):
+            return f"{path}: {committed!r} -> {fresh!r} (type changed)"
+        return None
+    scale = max(abs(c), check.atol_floor)
+    if check.direction == "min":
+        if f < c - check.rtol * scale:
+            return (
+                f"{path}: {c:.6g} -> {f:.6g} (below floor envelope "
+                f"rtol={check.rtol})"
+            )
+        return None
+    if check.direction == "max":
+        if f > c + check.rtol * scale:
+            return (
+                f"{path}: {c:.6g} -> {f:.6g} (above ceiling envelope "
+                f"rtol={check.rtol})"
+            )
+        return None
+    if abs(f - c) > check.rtol * scale:
+        return f"{path}: {c:.6g} -> {f:.6g} (rtol={check.rtol})"
+    return None
+
+
+def perf_diff(
+    fresh_dir, committed_dir, *, artifacts: Optional[list] = None,
+) -> dict:
+    """Compare fresh bench JSON against the committed artifacts.
+
+    Returns {"artifacts": {name: {"status", "failures", "checked"}},
+    "ok": bool}. Every committed non-manifest artifact present in
+    ``fresh_dir`` is compared: top-level key sets must match exactly
+    (the drift-guard contract), then the artifact's ``PERF_TOLERANCES``
+    checks run over matching leaves. A fresh artifact missing a checked
+    leaf fails (a silently vanished gate is a regression, not a pass).
+    """
+    fresh_dir, committed_dir = Path(fresh_dir), Path(committed_dir)
+    out: dict[str, Any] = {"artifacts": {}, "ok": True}
+    names = sorted(
+        p.name for p in committed_dir.glob("*.json")
+        if not p.name.endswith(".manifest.json")
+    )
+    if artifacts:
+        names = [n for n in names if n in set(artifacts)]
+    for name in names:
+        fresh_path = fresh_dir / name
+        entry: dict[str, Any] = {"failures": [], "checked": 0}
+        out["artifacts"][name] = entry
+        if not fresh_path.exists():
+            entry["status"] = "missing"
+            continue
+        committed = json.loads((committed_dir / name).read_text())
+        fresh = json.loads(fresh_path.read_text())
+        if set(committed) != set(fresh):
+            entry["failures"].append(
+                f"top-level keys drifted: extra={set(fresh) - set(committed)}"
+                f", missing={set(committed) - set(fresh)}"
+            )
+        checks = PERF_TOLERANCES.get(name, ())
+        committed_leaves = dict(_iter_leaves(committed))
+        fresh_leaves = dict(_iter_leaves(fresh))
+        for check in checks:
+            matched = False
+            for path_t, cval in committed_leaves.items():
+                dotted = ".".join(path_t)
+                if not fnmatch.fnmatch(dotted, check.pattern):
+                    continue
+                matched = True
+                if check.bool_only and not isinstance(cval, bool):
+                    continue
+                entry["checked"] += 1
+                if path_t not in fresh_leaves:
+                    entry["failures"].append(
+                        f"{dotted}: present in committed, missing in fresh"
+                    )
+                    continue
+                msg = _check_leaf(check, dotted, cval, fresh_leaves[path_t])
+                if msg is not None:
+                    entry["failures"].append(msg)
+            if not matched:
+                entry["failures"].append(
+                    f"tolerance pattern {check.pattern!r} matched nothing "
+                    "in the committed artifact (stale spec)"
+                )
+        entry["status"] = "ok" if not entry["failures"] else "regressed"
+        if entry["failures"]:
+            out["ok"] = False
+    return out
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def _cmd_list(args) -> int:
+    records = build_index(
+        args.root,
+        config_hash=args.config_hash,
+        structural_hash=args.structural_hash,
+        backend=args.backend,
+        platform=args.platform,
+        kind=args.kind,
+        label=args.label,
+    )
+    if args.json:
+        print(json.dumps(
+            [dataclasses.asdict(r) for r in records], indent=1,
+        ))
+        return 0
+    print(_HEADER)
+    print("-" * len(_HEADER))
+    for rec in records:
+        print(rec.row())
+    print(f"{len(records)} manifest(s) under {args.root}")
+    return 0
+
+
+def _cmd_compare(args) -> int:
+    diff = compare_manifests(load_manifest(args.a), load_manifest(args.b))
+    if args.json:
+        print(json.dumps(diff, indent=1, default=str))
+        return 0
+    print(f"A: {diff['a']['label']}  ({diff['a']['config_hash']})")
+    print(f"B: {diff['b']['label']}  ({diff['b']['config_hash']})")
+    print(
+        f"config: {'IDENTICAL' if diff['same_config_hash'] else 'differs'}"
+        f"; structural (serving-cohort) match: {diff['structural_match']}"
+    )
+    for k, pair in diff["config_diff"].items():
+        print(f"  config.{k}: {pair[0]!r} -> {pair[1]!r}")
+    for k, pair in diff["provenance_diff"].items():
+        print(f"  provenance.{k}: {pair[0]!r} -> {pair[1]!r}")
+    for k, row in diff["headline"].items():
+        r = row["b_over_a"]
+        print(
+            f"  {k}: {row['a']} vs {row['b']}"
+            + (f"  (B/A = {r:.3f})" if r is not None else "")
+        )
+    return 0
+
+
+def _cmd_perf_diff(args) -> int:
+    result = perf_diff(
+        args.fresh, args.committed, artifacts=args.artifact or None,
+    )
+    n_ok = n_checked = 0
+    for name, entry in result["artifacts"].items():
+        n_checked += entry["checked"]
+        status = entry["status"]
+        if status == "ok":
+            n_ok += 1
+            print(f"[perf-diff] OK        {name} ({entry['checked']} checks)")
+        elif status == "missing":
+            print(f"[perf-diff] MISSING   {name} (no fresh artifact)")
+        else:
+            print(f"[perf-diff] REGRESSED {name}")
+            for msg in entry["failures"]:
+                print(f"    {msg}")
+    total = len(result["artifacts"])
+    print(
+        f"[perf-diff] {n_ok}/{total} artifacts ok, {n_checked} leaf checks, "
+        f"fresh={args.fresh} committed={args.committed}"
+    )
+    return 0 if result["ok"] else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="distributed_optimization_tpu.observatory",
+        description=(
+            "Run registry + perf-regression checker over RunTrace "
+            "manifests and bench sidecars (docs/OBSERVABILITY.md)."
+        ),
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pl = sub.add_parser(
+        "list", help="index manifests under a directory and print a table",
+    )
+    pl.add_argument("root", help="directory (or single file) to index")
+    pl.add_argument("--config-hash", default=None)
+    pl.add_argument("--structural-hash", default=None,
+                    help="filter by the serving-cohort structural hash "
+                         "(recomputed from each manifest's config)")
+    pl.add_argument("--backend", default=None)
+    pl.add_argument("--platform", default=None)
+    pl.add_argument("--kind", default=None,
+                    choices=("run_trace", "bench_manifest"))
+    pl.add_argument("--label", default=None,
+                    help="case-insensitive substring on label/artifact")
+    pl.add_argument("--json", action="store_true")
+    pl.set_defaults(fn=_cmd_list)
+
+    pc = sub.add_parser(
+        "compare", help="field-level diff of two manifests",
+    )
+    pc.add_argument("a", help="manifest path (.json, or .jsonl[:line])")
+    pc.add_argument("b")
+    pc.add_argument("--json", action="store_true")
+    pc.set_defaults(fn=_cmd_compare)
+
+    pd = sub.add_parser(
+        "perf-diff",
+        help="check regenerated bench JSON against committed docs/perf "
+             "within per-artifact tolerances (exit 1 on regression)",
+    )
+    pd.add_argument("--fresh", default="docs/perf",
+                    help="directory of freshly regenerated artifacts "
+                         "(default: docs/perf — a self-check)")
+    pd.add_argument("--committed", default="docs/perf")
+    pd.add_argument("--artifact", action="append",
+                    help="restrict to this artifact name (repeatable)")
+    pd.set_defaults(fn=_cmd_perf_diff)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,206 @@
+"""Hierarchical span tracing with Chrome trace-event export.
+
+The flat ``PhaseTimer`` (PR 5) answers "how many seconds went to compile
+vs run" but not "WHICH request's cohort paid that compile, and where
+inside it the time went". This module replaces it with spans: named,
+nested, timestamped intervals (request → cohort → compile → run → chunk)
+that still aggregate to the same ``{name: seconds}`` phase dict every
+existing consumer reads (reports, ``--json``, manifests), plus a Chrome
+trace-event JSON export viewable in chrome://tracing or Perfetto.
+
+``Tracer`` is a drop-in superset of ``PhaseTimer``:
+
+- ``with tracer.phase("compile"):`` / ``with tracer.span("run", id=7):``
+  time a live interval; nesting is tracked per thread (the serving
+  daemon's handler threads each get their own span stack), so children
+  recorded inside a parent's ``with`` body parent correctly.
+- ``tracer.add_span(name, seconds)`` records a post-hoc interval whose
+  duration was measured elsewhere (the backend's AOT compile seconds) —
+  it lands as a child of the thread's current open span.
+- ``tracer.phases`` is a real, writable dict aggregating seconds by span
+  name — existing code that reads or adjusts it keeps working unchanged
+  (the Simulator's compile/run split assigns into it directly).
+- ``to_chrome_trace()`` / ``write_chrome_trace(path)`` export complete
+  ("ph": "X") events with microsecond timestamps; ``chrome_events()``
+  returns the raw event list for embedding in manifests.
+
+``utils/profiling.PhaseTimer`` is now an alias of ``Tracer``, so every
+bench script's existing ``PhaseTimer()`` transparently records spans and
+its manifest sidecar gains the span tree for free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Iterator, Optional
+
+import contextlib
+
+
+class Tracer:
+    """Span recorder + phase aggregator (see module docstring).
+
+    Thread-safe: span completion appends under a lock; the per-thread
+    open-span stack lives in a ``threading.local``.
+    """
+
+    def __init__(self, phases: Optional[dict] = None):
+        # Aggregate seconds by span name — the PhaseTimer-compatible
+        # surface. A plain dict on purpose: callers assign into it.
+        self.phases: dict[str, float] = dict(phases or {})
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._next_id = 0
+        # Epoch anchor so timestamps from perf_counter are absolute-ish
+        # and comparable across tracers in one process.
+        self._t0_wall = time.time() - time.perf_counter()
+
+    # ------------------------------------------------------------- spans
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def _record(self, name, start, duration, parent_id, args, aggregate):
+        with self._lock:
+            self._next_id += 1
+            ev = {
+                "id": self._next_id,
+                "name": name,
+                "start": start,  # perf_counter seconds
+                "duration": duration,
+                "parent": parent_id,
+                "thread": threading.current_thread().name,
+            }
+            if args:
+                ev["args"] = dict(args)
+            self._events.append(ev)
+            if aggregate:
+                self.phases[name] = self.phases.get(name, 0.0) + duration
+            return ev
+
+    @contextlib.contextmanager
+    def span(self, name: str, aggregate: bool = True, **args) -> Iterator[None]:
+        """Time a live interval; nests under the thread's open span.
+        ``aggregate=False`` records the span without folding its duration
+        into ``phases`` — for grouping spans (a request, a labeled run)
+        whose children already account the same seconds."""
+        stack = self._stack()
+        parent_id = stack[-1] if stack else None
+        with self._lock:
+            self._next_id += 1
+            span_id = self._next_id
+        stack.append(span_id)
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            duration = time.perf_counter() - start
+            stack.pop()
+            with self._lock:
+                ev = {
+                    "id": span_id,
+                    "name": name,
+                    "start": start,
+                    "duration": duration,
+                    "parent": parent_id,
+                    "thread": threading.current_thread().name,
+                }
+                if args:
+                    ev["args"] = dict(args)
+                self._events.append(ev)
+                if aggregate:
+                    self.phases[name] = self.phases.get(name, 0.0) + duration
+
+    # PhaseTimer compatibility: same name, same semantics, now a span.
+    phase = span
+
+    def add_span(
+        self,
+        name: str,
+        seconds: float,
+        *,
+        start: Optional[float] = None,
+        aggregate: bool = True,
+        **args,
+    ) -> None:
+        """Record an interval measured elsewhere (e.g. the backend's AOT
+        compile seconds) as a child of the thread's current open span.
+        ``start`` defaults to "it just ended" (now − seconds)."""
+        stack = self._stack()
+        parent_id = stack[-1] if stack else None
+        if start is None:
+            start = time.perf_counter() - seconds
+        self._record(name, start, float(seconds), parent_id, args, aggregate)
+
+    # ------------------------------------------------------------ reading
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return [dict(ev) for ev in self._events]
+
+    def report(self) -> str:
+        """The PhaseTimer text table (share-of-total per phase name)."""
+        total = sum(self.phases.values())
+        lines = [f"{'phase':<24}{'seconds':>10}{'share':>8}"]
+        for name, secs in sorted(self.phases.items(), key=lambda kv: -kv[1]):
+            share = secs / total if total > 0 else 0.0
+            lines.append(f"{name:<24}{secs:>10.3f}{share:>7.1%}")
+        lines.append(f"{'total':<24}{total:>10.3f}")
+        return "\n".join(lines)
+
+    # ------------------------------------------------------ chrome export
+    def chrome_events(self) -> list[dict]:
+        """Complete ("ph": "X") trace events, µs timestamps, one tid per
+        recording thread — the list ``write_bench_manifest`` embeds."""
+        tids: dict[str, int] = {}
+        out = []
+        with self._lock:
+            events = [dict(ev) for ev in self._events]
+        for ev in sorted(events, key=lambda e: e["start"]):
+            tid = tids.setdefault(ev["thread"], len(tids))
+            entry = {
+                "name": ev["name"],
+                "ph": "X",
+                "ts": (self._t0_wall + ev["start"]) * 1e6,
+                "dur": ev["duration"] * 1e6,
+                "pid": os.getpid(),
+                "tid": tid,
+            }
+            args = dict(ev.get("args") or {})
+            if ev.get("parent") is not None:
+                args["parent_span"] = ev["parent"]
+            args["span"] = ev["id"]
+            entry["args"] = args
+            out.append(entry)
+        return out
+
+    def to_chrome_trace(self) -> dict:
+        """The chrome://tracing / Perfetto JSON object (thread-name
+        metadata rows + the complete events)."""
+        events = self.chrome_events()
+        with self._lock:
+            raw = [dict(ev) for ev in self._events]
+        tids: dict[str, int] = {}
+        for ev in sorted(raw, key=lambda e: e["start"]):
+            tids.setdefault(ev["thread"], len(tids))
+        meta = [
+            {
+                "name": "thread_name", "ph": "M", "pid": os.getpid(),
+                "tid": tid, "args": {"name": thread},
+            }
+            for thread, tid in sorted(tids.items(), key=lambda kv: kv[1])
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path) -> Path:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(self.to_chrome_trace()) + "\n")
+        return p

@@ -1,0 +1,14 @@
+"""``python -m distributed_optimization_tpu.observatory`` — the run
+registry + perf-regression CLI.
+
+Indexes RunTrace manifests and bench sidecars into a queryable listing
+(``list``), diffs two runs (``compare``), and re-checks regenerated bench
+JSON against the committed ``docs/perf/*`` within per-artifact tolerances
+(``perf-diff``; ``make perf-diff``). All subcommands live on
+``observability.observatory.main`` (docs/OBSERVABILITY.md).
+"""
+
+from distributed_optimization_tpu.observability.observatory import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

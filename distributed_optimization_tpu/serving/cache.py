@@ -120,23 +120,52 @@ class ExecutableCache:
         self.misses = 0
         self.evictions = 0
         self.compile_seconds_saved = 0.0
+        # Registry instrumentation (ISSUE-10): every cache instance feeds
+        # the process-wide counters — a scrape sees the whole process's
+        # compile amortization, whichever cache instances produced it.
+        from distributed_optimization_tpu.observability.metrics_registry import (
+            metrics_registry,
+        )
+
+        reg = metrics_registry()
+        self._m_hits = reg.counter(
+            "dopt_exec_cache_hits_total", "Executable-cache hits")
+        self._m_misses = reg.counter(
+            "dopt_exec_cache_misses_total", "Executable-cache misses")
+        self._m_evictions = reg.counter(
+            "dopt_exec_cache_evictions_total", "Executable-cache evictions")
+        self._m_saved = reg.counter(
+            "dopt_exec_cache_compile_seconds_saved_total",
+            "Compile seconds avoided by executable-cache hits")
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
 
     def get(self, key: tuple) -> Optional[CacheEntry]:
-        """Look up a compiled program; counts a hit or a miss either way."""
+        """Look up a compiled program; counts a hit or a miss either way.
+
+        Registry counters are bumped AFTER the cache lock is released:
+        the registry's render/snapshot path calls back into the cache
+        (the entries/bytes gauges) while holding the registry lock, so
+        touching the registry while holding the cache lock would be the
+        classic ABBA deadlock against a concurrent ``/metrics`` scrape.
+        """
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
                 self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self.hits += 1
-            entry.hits += 1
-            self.compile_seconds_saved += entry.compile_seconds
-            return entry
+            else:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                entry.hits += 1
+                self.compile_seconds_saved += entry.compile_seconds
+        if entry is None:
+            self._m_misses.inc()
+        else:
+            self._m_hits.inc()
+            self._m_saved.inc(entry.compile_seconds)
+        return entry
 
     def put(
         self,
@@ -156,6 +185,7 @@ class ExecutableCache:
             compile_seconds=float(compile_seconds),
             est_bytes=estimate_executable_bytes(executable),
         )
+        n_evicted = 0
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
@@ -168,6 +198,9 @@ class ExecutableCache:
                 _, evicted = self._entries.popitem(last=False)
                 self._bytes -= evicted.est_bytes
                 self.evictions += 1
+                n_evicted += 1
+        if n_evicted:  # outside the cache lock — see get()
+            self._m_evictions.inc(n_evicted)
         return entry
 
     def clear(self) -> None:
@@ -188,6 +221,15 @@ class ExecutableCache:
                 "hit_rate": self.hits / lookups if lookups else None,
                 "compile_seconds_saved": float(self.compile_seconds_saved),
             }
+
+    @classmethod
+    def empty_stats(cls) -> dict:
+        """The zero-valued ``stats()`` shape, derived from a fresh
+        instance so it CANNOT drift from the real one — the
+        disabled-cache status block reuses it to keep the "counter keys
+        always present" contract (docs/SERVING.md) as counters are
+        added."""
+        return cls().stats()
 
 
 # ------------------------------------------------------- process-wide default
@@ -213,6 +255,29 @@ def process_executable_cache() -> Optional[ExecutableCache]:
     with _process_lock:
         if _process_cache is None:
             _process_cache = ExecutableCache()
+            # Scrape-time gauges for the process cache's current state
+            # (entries/bytes are someone's source of truth, not events —
+            # the registry polls them so they can never go stale).
+            from distributed_optimization_tpu.observability.metrics_registry import (  # noqa: E501
+                metrics_registry,
+            )
+
+            reg = metrics_registry()
+            cache = _process_cache
+            # The callbacks run under the REGISTRY lock (scrape time), so
+            # they must not take the cache lock (ABBA vs get/put, which
+            # bump registry counters) — plain attribute reads are atomic
+            # enough for a gauge, and a one-entry-stale reading is fine.
+            reg.gauge_fn(
+                "dopt_exec_cache_entries",
+                "Compiled programs resident in the process executable cache",
+                lambda: len(cache._entries),
+            )
+            reg.gauge_fn(
+                "dopt_exec_cache_bytes",
+                "Estimated resident bytes of the process executable cache",
+                lambda: cache._bytes,
+            )
         return _process_cache
 
 
@@ -271,11 +336,16 @@ def sequential_cache_key(
     mesh_signature=None,
     hoisted_min_ratio=None,
     eval_hoist_limit=None,
+    segment=None,
 ) -> tuple:
     """Cache key for the sequential fused-scan program (``_run``'s
     no-checkpoint path). Everything per-run is baked there — the PRNG key,
     the hyperparameter scalars, f* — so the key is the FULL config hash
-    plus the call-level knobs that alter the trace."""
+    plus the call-level knobs that alter the trace. ``segment`` carries
+    the progress-streaming segmentation facts (segment size in evals):
+    the segmented program takes its iteration offset as a TRACED argument
+    where the one-shot program bakes t0=0, so the two must never share an
+    executable."""
     return (
         "seq",
         _full_config_hash(config),
@@ -286,6 +356,7 @@ def sequential_cache_key(
         mesh_signature,
         hoisted_min_ratio,
         eval_hoist_limit,
+        segment,
         _jax_env_signature(),
     )
 
@@ -299,6 +370,7 @@ def batch_cache_key(
     rp_keys,
     sweep_fields,
     collect_metrics: bool = True,
+    segment=None,
 ) -> tuple:
     """Cache key for the replica-batched program (``run_batch``).
 
@@ -310,7 +382,9 @@ def batch_cache_key(
     constants when not on the replica axis), the set of per-replica inputs
     the trace was built with (``rp_keys`` — presence changes the input
     pytree), the cohort size R, the continuation offset t0 (timeline
-    horizons are t0+T), and the data signature.
+    horizons are t0+T), and the data signature. ``segment`` carries the
+    progress-streaming segmentation facts (the per-call trip count
+    differs from the one-shot program's).
     """
     sweep_fields = set(sweep_fields)
     unswept = tuple(
@@ -327,5 +401,6 @@ def batch_cache_key(
         unswept,
         dataset_signature(device_data),
         bool(collect_metrics),
+        segment,
         _jax_env_signature(),
     )

@@ -152,11 +152,19 @@ def plan_cohorts(requests, max_cohort: int) -> list[CohortPlan]:
 
 def execute_plan(
     plan: CohortPlan, dataset, f_opt: float, *, executable_cache=None,
-    collect_metrics: bool = True,
+    collect_metrics: bool = True, progress_factory=None,
+    cohort_progress_cb=None, progress_every: int = 1,
 ):
     """Run one plan; returns the per-request ``BackendRunResult`` list
     (plan order). Coalesced plans go through ``run_batch`` and slice per
-    replica; sequential plans through ``run_algorithm`` one at a time."""
+    replica; sequential plans through ``run_algorithm`` one at a time.
+
+    Progress streaming (ISSUE-10): ``progress_factory(request)`` builds a
+    per-request heartbeat callback for sequential plans (jax, tp=1 only —
+    the other entry points have no chunked form); ``cohort_progress_cb``
+    receives the batched cohort's heartbeats (per-replica gaps attached —
+    the service fans them out to each request's stream).
+    """
     if plan.sequential_reason is not None:
         from distributed_optimization_tpu.backends.base import run_algorithm
 
@@ -167,6 +175,11 @@ def execute_plan(
                 # The sequential jax path still reuses identical-program
                 # compiles; numpy/cpp/TP entry points take no cache.
                 kwargs["executable_cache"] = executable_cache
+                if progress_factory is not None:
+                    cb = progress_factory(req)
+                    if cb is not None:
+                        kwargs["progress_cb"] = cb
+                        kwargs["progress_every"] = progress_every
             out.append(run_algorithm(req.config, dataset, f_opt, **kwargs))
         return out
     from distributed_optimization_tpu.backends import jax_backend
@@ -176,5 +189,7 @@ def execute_plan(
         seeds=plan.seeds, sweep=plan.sweep,
         collect_metrics=collect_metrics,
         executable_cache=executable_cache,
+        progress_cb=cohort_progress_cb,
+        progress_every=progress_every,
     )
     return list(batch.results)

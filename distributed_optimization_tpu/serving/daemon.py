@@ -16,8 +16,20 @@ encoding, so ``jq``/``JSON.parse`` read them even for divergent runs):
 - ``GET /v1/result/<id>[?timeout=S]`` — the manifest once done (200), a
   status object while queued/running (202), 404 for unknown ids, 500
   body with the failure message for failed requests.
+- ``GET /v1/progress/<id>[?timeout=S&after=SEQ]`` — LIVE streaming JSONL
+  (ISSUE-10): one line per heartbeat (lifecycle events + the backend's
+  per-chunk progress — iteration, wall seconds, current gap/consensus,
+  live B̂, staleness quantiles on async runs), replayed from ``after``
+  and followed until the request finishes or ``timeout`` (default 300 s)
+  elapses. The response has no Content-Length and closes when the
+  stream ends — read it line by line (``curl -N``).
 - ``GET /v1/status``   — service stats: queue depth, cohort/coalescing
-  counters, executable-cache hits/misses/compile-seconds-saved.
+  counters, executable-cache hits/misses/compile-seconds-saved (counter
+  blocks ALWAYS present, zeros before any work), and the bounded
+  last-K finished-request history.
+- ``GET /metrics``     — the process metrics registry in Prometheus text
+  exposition format (cache, coalescer, queue, progress, async-staleness
+  families; one consistent snapshot per scrape).
 - ``POST /v1/shutdown`` — drain nothing, stop accepting, exit cleanly.
 """
 
@@ -189,11 +201,59 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._respond_request(req)
 
+    def _stream_progress(self, req) -> None:
+        """Stream a request's heartbeats as JSONL until it finishes (or
+        the timeout elapses). No Content-Length — the body is terminated
+        by connection close, so a client reads lines as they arrive
+        (``curl -N``); buffered events replay first (``?after=SEQ``
+        resumes a reconnect past what it already saw)."""
+        q = self._query()
+        try:
+            after = int(q["after"][0]) if "after" in q else -1
+        except ValueError:
+            after = -1
+        timeout = self._timeout(DEFAULT_RUN_TIMEOUT_S)
+        self.close_connection = True
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            for payload in req.progress.follow(after, timeout=timeout):
+                self.wfile.write(_strict_json(payload))
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream; nothing to clean up
+
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         path = urlparse(self.path).path.rstrip("/")
         service = self.server.service
         if path == "/v1/status":
             self._send(200, {"status": "serving", **service.stats()})
+            return
+        if path == "/metrics":
+            from distributed_optimization_tpu.observability.metrics_registry import (  # noqa: E501
+                metrics_registry,
+            )
+
+            body = metrics_registry().render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path.startswith("/v1/progress/"):
+            request_id = path[len("/v1/progress/"):]
+            try:
+                req = service.get(request_id)
+            except KeyError:
+                self._error(404, "unknown_request", request_id)
+                return
+            self._stream_progress(req)
             return
         if path.startswith("/v1/result/"):
             request_id = path[len("/v1/result/"):]

@@ -38,6 +38,14 @@ from typing import Any, Optional
 
 from distributed_optimization_tpu.config import ExperimentConfig
 from distributed_optimization_tpu.log import get_logger
+from distributed_optimization_tpu.observability.metrics_registry import (
+    metrics_registry,
+)
+from distributed_optimization_tpu.observability.progress import (
+    ProgressEvent,
+    ProgressStream,
+)
+from distributed_optimization_tpu.observability.spans import Tracer
 from distributed_optimization_tpu.serving.cache import (
     ExecutableCache,
     process_executable_cache,
@@ -89,14 +97,27 @@ class ServingOptions:
     their result payloads/manifests) are dropped — a later result poll for
     an evicted id gets "unknown request", the serving analogue of a log
     rotation. Pending/running requests are never evicted.
+    ``progress_every`` is the heartbeat cadence (in eval-chunks) of the
+    live progress streams (``/v1/progress/<id>``): every executed plan
+    runs with progress on, in segments of this many eval-chunks — the
+    continuation machinery, bitwise the one-shot program.
     """
 
     window_s: float = 0.05
     max_cohort: int = 32
     max_pending: int = 1024
     max_done: int = 512
+    # Heartbeats every 5 eval-chunks: the measured sweet spot on the
+    # bench container (docs/perf/observatory.json — per-eval heartbeats
+    # cost ~14% there, every-5 ~4%, and a served cohort's wall time is
+    # dominated by its compile anyway).
+    progress_every: int = 5
 
     def __post_init__(self) -> None:
+        if self.progress_every < 1:
+            raise ValueError(
+                f"progress_every must be >= 1, got {self.progress_every}"
+            )
         if self.window_s < 0:
             raise ValueError(f"window_s must be >= 0, got {self.window_s}")
         if self.max_cohort < 1:
@@ -122,6 +143,13 @@ class Request:
     submitted_at: float
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False
+    )
+    # Live heartbeat channel (ISSUE-10): lifecycle events (queued →
+    # running → done/failed) plus the backend's per-chunk progress while
+    # the request executes — what the daemon's ``/v1/progress/<id>``
+    # streams. Closed when the request finishes.
+    progress: ProgressStream = dataclasses.field(
+        default_factory=ProgressStream, repr=False
     )
     status: str = QUEUED
     result: Any = None  # BackendRunResult when DONE
@@ -233,6 +261,33 @@ class SimulationService:
         self.n_cohorts = 0
         self.data_gen_seconds = 0.0
         self.oracle_seconds = 0.0
+        # Span tracing (ISSUE-10): request → cohort → compile/run spans,
+        # exportable as a Chrome trace; per-request subtrees land in the
+        # response manifests.
+        self.tracer = Tracer()
+        # Metrics registry instrumentation: the process-wide families a
+        # /metrics scrape reads. Counters accumulate across service
+        # instances; the queue-depth gauge polls the NEWEST service
+        # (gauge_fn re-registration replaces the callback).
+        reg = metrics_registry()
+        self._m_requests = reg.counter(
+            "dopt_serving_requests_total",
+            "Serving requests by terminal status",
+        )
+        self._m_cohort_size = reg.histogram(
+            "dopt_serving_cohort_size",
+            "Coalesced cohort sizes (requests per executed plan)",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        self._m_queue_wait = reg.histogram(
+            "dopt_serving_queue_wait_seconds",
+            "Submit-to-execution-start wait per request",
+        )
+        reg.gauge_fn(
+            "dopt_serving_queue_depth",
+            "Requests pending in the serving queue",
+            self.queue_depth,
+        )
 
     # ---------------------------------------------------------- submission
     def submit(self, config) -> str:
@@ -256,6 +311,18 @@ class SimulationService:
                 config=cfg,
                 submitted_at=time.perf_counter(),
             )
+            # QUEUED must hit the stream BEFORE the request becomes
+            # visible to the scheduler (the append): published after the
+            # lock released, a scheduler thread already past its wait
+            # could pop the request and publish RUNNING first, handing
+            # subscribers an out-of-order lifecycle. The stream lock is a
+            # leaf (publish never calls back into the service), so
+            # publishing under the service lock cannot invert an order.
+            req.progress.publish(ProgressEvent(
+                kind="lifecycle", iteration=0,
+                n_iterations=cfg.n_iterations, wall_seconds=0.0,
+                status=QUEUED,
+            ))
             self._pending.append(req)
             self._requests[req.id] = req
         self._wake.set()
@@ -344,6 +411,30 @@ class SimulationService:
             self._datasets[key] = (ds, float(f_opt))
         return ds, float(f_opt)
 
+    def _plan_progress(self, plan):
+        """Heartbeat plumbing for one executed plan (ISSUE-10): sequential
+        requests get their own backend callback; a batched cohort's
+        heartbeats fan out to every member with ITS replica's gap swapped
+        in (the cohort-level mean stays in ``extra``)."""
+
+        def progress_factory(req):
+            return req.progress.publish
+
+        def cohort_cb(ev):
+            per_replica = ev.gap_per_replica
+            for idx, req in enumerate(plan.requests):
+                if per_replica is not None and idx < len(per_replica):
+                    ev_r = dataclasses.replace(
+                        ev, gap=per_replica[idx], gap_per_replica=None,
+                        extra={"cohort_gap_mean": ev.gap,
+                               "cohort_size": plan.size},
+                    )
+                else:
+                    ev_r = ev
+                req.progress.publish(ev_r)
+
+        return progress_factory, cohort_cb
+
     def _execute(self, plan) -> None:
         t_start = time.perf_counter()
         for req in plan.requests:
@@ -352,22 +443,50 @@ class SimulationService:
             req.cohort_size = plan.size
             req.coalesced = plan.coalesced
             req.sequential_reason = plan.sequential_reason
+            req.progress.publish(ProgressEvent(
+                kind="lifecycle", iteration=0,
+                n_iterations=req.config.n_iterations, wall_seconds=0.0,
+                status=RUNNING,
+                extra={"cohort_size": plan.size,
+                       "coalesced": plan.coalesced},
+            ))
+        progress_factory, cohort_cb = self._plan_progress(plan)
+        # Per-plan span tree (request → cohort → compile/run → the
+        # backend's chunks): embedded in each member's manifest and
+        # aggregated into the service tracer's flat phases.
+        plan_tracer = Tracer()
         try:
-            ds, f_opt = self._dataset_for(plan.base)
-            results = execute_plan(
-                plan, ds, f_opt,
-                # Honor the kill switch: no cache means COLD compiles, not
-                # a silently substituted private cache.
-                executable_cache=(
-                    self.cache if self.cache is not None else False
-                ),
-            )
-            wall = time.perf_counter() - t_start
+            with plan_tracer.span(
+                "cohort", aggregate=False, size=plan.size,
+                coalesced=plan.coalesced,
+                structural_hash=plan.base.structural_hash(),
+            ):
+                ds, f_opt = self._dataset_for(plan.base)
+                results = execute_plan(
+                    plan, ds, f_opt,
+                    # Honor the kill switch: no cache means COLD compiles,
+                    # not a silently substituted private cache.
+                    executable_cache=(
+                        self.cache if self.cache is not None else False
+                    ),
+                    progress_factory=progress_factory,
+                    cohort_progress_cb=cohort_cb,
+                    progress_every=self.options.progress_every,
+                )
+                wall = time.perf_counter() - t_start
+                compile_s = min(
+                    results[0].history.compile_seconds, wall
+                ) if results else 0.0
+                plan_tracer.add_span("compile", compile_s, start=t_start)
+                plan_tracer.add_span(
+                    "run", wall - compile_s, start=t_start + compile_s
+                )
         except Exception as e:  # isolate the poison plan, keep serving
             msg = f"{type(e).__name__}: {e}"
             _log.warning("plan of %d request(s) failed: %s", plan.size, msg)
             with self._lock:
                 self.n_failed += plan.size
+            self._m_requests.inc(plan.size, status="failed")
             for req in plan.requests:
                 req.status = FAILED
                 req.error = msg
@@ -382,6 +501,15 @@ class SimulationService:
             self.n_done += plan.size
             if plan.sequential_reason is not None:
                 self.n_sequential += plan.size
+            for name, secs in plan_tracer.phases.items():
+                self.tracer.phases[name] = (
+                    self.tracer.phases.get(name, 0.0) + secs
+                )
+        self._m_requests.inc(plan.size, status="done")
+        self._m_cohort_size.observe(plan.size)
+        self._m_queue_wait.observe_many(
+            [r.queue_wait_s for r in plan.requests]
+        )
         jax_cached_path = (
             plan.base.backend == "jax" and plan.base.tp_degree == 1
             and self.cache is not None
@@ -399,7 +527,9 @@ class SimulationService:
                 if jax_cached_path else None
             )
             req.run_wall_s = wall
-            req.manifest = self._manifest(req, res)
+            req.manifest = self._manifest(
+                req, res, spans=plan_tracer.chrome_events()
+            )
             req.status = DONE
             self._finish(req)
 
@@ -407,17 +537,31 @@ class SimulationService:
         """Mark a request finished and rotate the bounded history: beyond
         ``max_done`` completed records, the oldest finished request (and
         its result payload) is dropped — later polls for its id get
-        "unknown request". Pending/running requests are never evicted."""
+        "unknown request". Pending/running requests are never evicted.
+        The request's progress stream gets its terminal lifecycle event
+        and closes — a ``/v1/progress`` follower unblocks here."""
+        req.progress.publish(ProgressEvent(
+            kind="lifecycle",
+            iteration=(
+                req.config.n_iterations if req.status == DONE else 0
+            ),
+            n_iterations=req.config.n_iterations,
+            wall_seconds=req.run_wall_s or 0.0,
+            status=req.status,
+            extra={"error": req.error} if req.error else None,
+        ))
+        req.progress.close()
         req.done.set()
         with self._lock:
             self._done_order.append(req.id)
             while len(self._done_order) > self.options.max_done:
                 self._requests.pop(self._done_order.popleft(), None)
 
-    def _manifest(self, req: Request, res) -> dict:
+    def _manifest(self, req: Request, res, spans=None) -> dict:
         """The request's RunTrace manifest (the daemon's response body):
         config + hash, phases, trace buffers when the request asked for
-        telemetry, and the health block extended with the serving facts."""
+        telemetry, the health block extended with the serving facts, and
+        (schema v2) the plan's span tree."""
         from distributed_optimization_tpu import telemetry
 
         health = telemetry.health_summary(
@@ -430,6 +574,7 @@ class SimulationService:
                 "run": req.run_wall_s or 0.0,
             },
             health=health,
+            spans=spans,
         ).to_dict()
 
     # ----------------------------------------------------- background loop
@@ -469,12 +614,38 @@ class SimulationService:
 
     # ------------------------------------------------------------ telemetry
     def stats(self) -> dict:
-        """Service-level counters: queue, cohorts, cache (JSON-safe)."""
+        """Service-level counters: queue, cohorts, cache (JSON-safe).
+
+        Shape contract (ISSUE-10 satellite, docs/SERVING.md): the
+        ``cache`` and ``cohorts``/``queue_wait_s`` blocks are ALWAYS
+        present with every counter key — zeros before any work, and the
+        full counter set even when the executable cache is disabled
+        (``disabled: true`` rides alongside) — so dashboards and the
+        ``/metrics`` bridge never have to special-case a cold daemon.
+        ``history`` documents the bounded (last-``max_done``) finished-
+        request retention and lists the most recent completions.
+        """
         import numpy as np
 
+        if self.cache is not None:
+            cache_stats = self.cache.stats()
+        else:
+            # The kill switch still answers with the full counter shape —
+            # derived from the cache class itself so it cannot drift as
+            # counters are added.
+            from distributed_optimization_tpu.serving.cache import (
+                ExecutableCache,
+            )
+
+            cache_stats = {"disabled": True, **ExecutableCache.empty_stats()}
         with self._lock:
             sizes = list(self.cohort_sizes)
             waits = list(self.queue_waits)
+            recent = [
+                self._requests[rid].status_dict()
+                for rid in list(self._done_order)[-16:]
+                if rid in self._requests
+            ]
             out = {
                 "queue_depth": len(self._pending),
                 "requests_total": self._counter,
@@ -494,9 +665,17 @@ class SimulationService:
                 },
                 "data_gen_seconds": self.data_gen_seconds,
                 "oracle_seconds": self.oracle_seconds,
-                "cache": (
-                    self.cache.stats() if self.cache is not None
-                    else {"disabled": True}
-                ),
+                "phases": {
+                    k: float(v) for k, v in self.tracer.phases.items()
+                },
+                "cache": cache_stats,
+                # Bounded per-request history: only the last ``bound``
+                # finished requests are retained (older ids answer
+                # "unknown request"); ``recent`` lists the newest 16.
+                "history": {
+                    "bound": self.options.max_done,
+                    "retained": len(self._done_order),
+                    "recent": recent,
+                },
             }
         return out

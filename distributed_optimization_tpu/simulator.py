@@ -100,10 +100,12 @@ class Simulator:
         self, base_config: ExperimentConfig, dataset: Optional[HostDataset] = None
     ):
         self.config = base_config
-        # Phase accounting (utils/profiling.PhaseTimer, ISSUE-5 satellite):
-        # data-gen, oracle, compile, and run wall-clock collected across the
-        # simulator's lifetime — surfaced in the text report, the JSON dump,
-        # and the RunTrace manifests.
+        # Phase accounting (ISSUE-5 satellite), now the hierarchical span
+        # tracer (ISSUE-10: ``observability/spans.Tracer``; ``PhaseTimer``
+        # is an alias): data-gen, oracle, compile, and run wall-clock
+        # collected across the simulator's lifetime — surfaced in the text
+        # report, the JSON dump, the RunTrace manifests, and exportable as
+        # a Chrome trace (``write_chrome_trace``).
         self.phase_timer = PhaseTimer()
         with self.phase_timer.phase("data_gen"):
             self.dataset = (
@@ -116,6 +118,14 @@ class Simulator:
                 huber_delta=base_config.huber_delta,
                 n_classes=base_config.n_classes,
             )
+        from distributed_optimization_tpu.observability.metrics_registry import (
+            observe_phases,
+        )
+
+        observe_phases({
+            "data_gen": self.phase_timer.phases.get("data_gen", 0.0),
+            "oracle": self.phase_timer.phases.get("oracle", 0.0),
+        })
         self.records: list[ExperimentRecord] = []
 
     # ------------------------------------------------------------------ runs
@@ -153,33 +163,49 @@ class Simulator:
         batch = None
         stats = None
         t_run = time.perf_counter()
-        if replicated:
-            # One vmapped program runs every replica (ISSUE-4): the record
-            # keeps replica 0 as the representative trajectory and the
-            # mean ± std statistics alongside.
-            batch = run_algorithm_batch(cfg, self.dataset, self.f_opt, **kwargs)
-            result = batch.results[0]
-            stats = summarize_replicates(
-                batch.objective,
-                batch.consensus_error,
-                result.history.eval_iterations,
-                cfg.suboptimality_threshold,
-                batch.seeds,
-                batch.aggregate_iters_per_second,
+        # The labeled span groups this run's compile/run children in the
+        # Chrome trace (aggregate=False: the children already account the
+        # same seconds in the flat phase table).
+        with self.phase_timer.span(f"run_one:{label}", aggregate=False):
+            if replicated:
+                # One vmapped program runs every replica (ISSUE-4): the
+                # record keeps replica 0 as the representative trajectory
+                # and the mean ± std statistics alongside.
+                batch = run_algorithm_batch(
+                    cfg, self.dataset, self.f_opt, **kwargs
+                )
+                result = batch.results[0]
+                stats = summarize_replicates(
+                    batch.objective,
+                    batch.consensus_error,
+                    result.history.eval_iterations,
+                    cfg.suboptimality_threshold,
+                    batch.seeds,
+                    batch.aggregate_iters_per_second,
+                )
+            else:
+                result = run_algorithm(cfg, self.dataset, self.f_opt, **kwargs)
+            total_seconds = time.perf_counter() - t_run
+            # Phase split: compile is measured inside the backend (AOT
+            # lowering); the remainder of the wall-clock around the call is
+            # the run phase. add_span records both as children of the
+            # labeled span AND folds them into the flat phase table.
+            compile_seconds = min(result.history.compile_seconds, total_seconds)
+            self.phase_timer.add_span(
+                "compile", compile_seconds, start=t_run
             )
-        else:
-            result = run_algorithm(cfg, self.dataset, self.f_opt, **kwargs)
-        total_seconds = time.perf_counter() - t_run
-        # Phase split: compile is measured inside the backend (AOT lowering);
-        # the remainder of the wall-clock around the call is the run phase.
-        compile_seconds = min(result.history.compile_seconds, total_seconds)
-        self.phase_timer.phases["compile"] = (
-            self.phase_timer.phases.get("compile", 0.0) + compile_seconds
+            self.phase_timer.add_span(
+                "run", total_seconds - compile_seconds,
+                start=t_run + compile_seconds,
+            )
+        from distributed_optimization_tpu.observability.metrics_registry import (
+            observe_phases,
         )
-        self.phase_timer.phases["run"] = (
-            self.phase_timer.phases.get("run", 0.0)
-            + total_seconds - compile_seconds
-        )
+
+        observe_phases({
+            "compile": compile_seconds,
+            "run": total_seconds - compile_seconds,
+        })
         summary = summarize_run(
             label,
             result.history,
@@ -301,7 +327,9 @@ class Simulator:
                 continue
             traces.append(build_run_trace(
                 rec.label, rec.config, rec.result.history,
-                phases=dict(self.phase_timer.phases),
+                # The Tracer carries both the flat phase dict and the
+                # span tree; build_run_trace records both (schema v2).
+                phases=self.phase_timer,
                 health=rec.health,
             ))
         return traces
@@ -312,6 +340,23 @@ class Simulator:
 
         write_jsonl(path, self.run_traces())
         _log.info("telemetry manifests saved to %s", path)
+
+    def write_chrome_trace(self, path) -> None:
+        """Export the simulator's span tree (data_gen/oracle + per-run
+        compile/run spans) as Chrome trace-event JSON — open in
+        chrome://tracing or https://ui.perfetto.dev (ISSUE-10)."""
+        self.phase_timer.write_chrome_trace(path)
+        _log.info("chrome trace saved to %s", path)
+
+    def metrics_text(self) -> str:
+        """The process metrics registry in Prometheus text format — the
+        same exposition the serving daemon's ``/metrics`` endpoint
+        scrapes, dumpable from scripts and the CLI (ISSUE-10)."""
+        from distributed_optimization_tpu.observability.metrics_registry import (
+            metrics_registry,
+        )
+
+        return metrics_registry().render()
 
     def plot_results(self, path: Optional[str] = None, show: bool = False):
         """Two-panel log-scale figure (reference ``simulator.py:161-201``)."""

@@ -42,7 +42,11 @@ import numpy as np
 
 # Manifest / trace schema version. Bump when a field is added, removed, or
 # changes meaning; ``RunTrace.from_dict`` rejects versions it does not know.
-SCHEMA_VERSION = 1
+# v2 (ISSUE-10): every manifest carries a ``provenance`` block (git SHA +
+# dirty flag, jax version, device kind — before it, only the platform
+# string was captured) and an optional ``spans`` list (Chrome trace
+# events from the span tracer).
+SCHEMA_VERSION = 2
 
 # The trace-buffer schema: field name -> row shape kind. 'per_worker'
 # fields are [n_evals, N] float32, 'scalar' fields are [n_evals] float32
@@ -76,7 +80,7 @@ TRACE_FIELDS: dict[str, str] = {
 _RUN_TRACE_KEYS = (
     "schema_version", "kind", "label", "backend", "platform", "config",
     "config_hash", "phases", "compile_seconds", "iters_per_second",
-    "eval_iterations", "cost", "trace", "health",
+    "eval_iterations", "cost", "trace", "health", "provenance", "spans",
 )
 
 # Top-level keys of a bench manifest sidecar (``write_bench_manifest``);
@@ -84,7 +88,7 @@ _RUN_TRACE_KEYS = (
 # artifacts against exactly this set.
 BENCH_MANIFEST_KEYS = (
     "schema_version", "kind", "artifact", "backend", "platform", "config",
-    "config_hash", "phases",
+    "config_hash", "phases", "provenance", "spans",
 )
 
 
@@ -159,6 +163,62 @@ def _platform() -> str:
         return "unknown"
 
 
+def _git_state() -> tuple:
+    """(sha, dirty) of the checkout this package runs from, or (None,
+    None) outside a git worktree — provenance is telemetry, never
+    control flow worth raising for."""
+    import subprocess
+
+    root = str(Path(__file__).resolve().parent.parent)
+    try:
+        sha = subprocess.run(
+            ["git", "-C", root, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+        if sha.returncode != 0:
+            return None, None
+        status = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10,
+        )
+        dirty = bool(status.stdout.strip()) if status.returncode == 0 else None
+        return sha.stdout.strip(), dirty
+    except Exception:
+        return None, None
+
+
+_PROVENANCE_CACHE: Optional[dict] = None
+
+
+def provenance(refresh: bool = False) -> dict:
+    """The run-environment facts every schema-v2 manifest records
+    (ISSUE-10 satellite): git SHA + dirty flag of the producing checkout,
+    ``jax.__version__``, and the device kind — before v2 only the
+    platform string was captured, which cannot distinguish two TPU
+    generations or tie a number to a commit. Cached per process (the
+    git subprocess is not free); ``refresh=True`` re-reads."""
+    global _PROVENANCE_CACHE
+    if _PROVENANCE_CACHE is not None and not refresh:
+        return dict(_PROVENANCE_CACHE)
+    sha, dirty = _git_state()
+    jax_version = None
+    device_kind = None
+    try:
+        import jax
+
+        jax_version = jax.__version__
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        pass
+    _PROVENANCE_CACHE = {
+        "git_sha": sha,
+        "git_dirty": dirty,
+        "jax_version": jax_version,
+        "device_kind": device_kind,
+    }
+    return dict(_PROVENANCE_CACHE)
+
+
 @dataclasses.dataclass
 class RunTrace:
     """One run's flight-recorder manifest (see the module docstring).
@@ -180,6 +240,11 @@ class RunTrace:
     cost: Optional[dict] = None
     trace: Optional[dict] = None
     health: Optional[dict] = None
+    # Schema v2: the producing environment (git sha/dirty, jax version,
+    # device kind — see ``provenance()``) and the span tracer's Chrome
+    # trace events (None when the producer recorded no spans).
+    provenance: Optional[dict] = None
+    spans: Optional[list] = None
     schema_version: int = SCHEMA_VERSION
     kind: str = "run_trace"
 
@@ -227,8 +292,14 @@ def build_run_trace(
     phases: Optional[dict] = None,
     health: Optional[dict] = None,
     platform: Optional[str] = None,
+    spans: Optional[list] = None,
 ) -> RunTrace:
-    """Assemble a ``RunTrace`` from an ``ExperimentConfig`` + ``RunHistory``."""
+    """Assemble a ``RunTrace`` from an ``ExperimentConfig`` + ``RunHistory``.
+
+    ``phases`` may be a plain dict or a span ``Tracer`` (its aggregated
+    ``.phases`` dict is recorded, and — unless ``spans`` is passed
+    explicitly — its Chrome trace events land in the ``spans`` field).
+    """
     cd = config.to_dict()
     trace = None
     if history.trace is not None:
@@ -236,19 +307,24 @@ def build_run_trace(
             k: np.asarray(v, dtype=np.float64).tolist()
             for k, v in history.trace.items()
         }
+    if spans is None and hasattr(phases, "chrome_events"):
+        spans = phases.chrome_events()
+    phase_dict = dict(getattr(phases, "phases", phases) or {})
     return RunTrace(
         label=label,
         backend=config.backend,
         platform=platform if platform is not None else _platform(),
         config=cd,
         config_hash=config_hash(cd),
-        phases=dict(phases or {}),
+        phases=phase_dict,
         compile_seconds=float(history.compile_seconds),
         iters_per_second=float(history.iters_per_second),
         eval_iterations=np.asarray(history.eval_iterations).tolist(),
         cost=history.cost,
         trace=trace,
         health=health,
+        provenance=provenance(),
+        spans=spans,
     )
 
 
@@ -565,6 +641,12 @@ def write_bench_manifest(
     if config is not None:
         cd = config.to_dict() if hasattr(config, "to_dict") else dict(config)
     phase_dict = dict(getattr(phases, "phases", phases) or {})
+    # Span tracing (schema v2): bench scripts pass their PhaseTimer —
+    # now a span Tracer — so the manifest carries the perfetto-viewable
+    # span tree alongside the flat phase totals, with no bench changes.
+    spans = (
+        phases.chrome_events() if hasattr(phases, "chrome_events") else None
+    )
     payload = {
         "schema_version": SCHEMA_VERSION,
         "kind": "bench_manifest",
@@ -574,6 +656,8 @@ def write_bench_manifest(
         "config": cd,
         "config_hash": config_hash(cd) if cd else None,
         "phases": {k: float(v) for k, v in phase_dict.items()},
+        "provenance": provenance(),
+        "spans": spans,
     }
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")

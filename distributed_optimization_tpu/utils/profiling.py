@@ -9,7 +9,10 @@ The reference's only observability is coarse per-iteration wall-clock deltas
   ``Simulator`` owns one (``phase_timer``): data-gen and oracle are timed
   at construction, each run splits into compile/run, and the phases land
   in the text report, ``--json``, and the telemetry manifests
-  (docs/OBSERVABILITY.md);
+  (docs/OBSERVABILITY.md). Since ISSUE-10 it IS the hierarchical span
+  tracer (``observability/spans.Tracer``): the flat ``{name: seconds}``
+  surface is unchanged, and every timed phase is also recorded as a span
+  with nesting and timestamps, exportable as a Chrome trace;
 - ``trace`` — context manager around ``jax.profiler`` trace collection for
   TensorBoard/XProf on real TPU runs, a no-op when profiling is unavailable.
 """
@@ -17,39 +20,19 @@ The reference's only observability is coarse per-iteration wall-clock deltas
 from __future__ import annotations
 
 import contextlib
-import dataclasses
-import time
 from typing import Iterator, Optional
 
 from distributed_optimization_tpu.log import get_logger
+from distributed_optimization_tpu.observability.spans import Tracer
 
 _log = get_logger("profiling")
 
-
-@dataclasses.dataclass
-class PhaseTimer:
-    """Accumulates wall-clock seconds per named phase."""
-
-    phases: dict[str, float] = dataclasses.field(default_factory=dict)
-
-    @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.phases[name] = (
-                self.phases.get(name, 0.0) + time.perf_counter() - start
-            )
-
-    def report(self) -> str:
-        total = sum(self.phases.values())
-        lines = [f"{'phase':<24}{'seconds':>10}{'share':>8}"]
-        for name, secs in sorted(self.phases.items(), key=lambda kv: -kv[1]):
-            share = secs / total if total > 0 else 0.0
-            lines.append(f"{name:<24}{secs:>10.3f}{share:>7.1%}")
-        lines.append(f"{'total':<24}{total:>10.3f}")
-        return "\n".join(lines)
+# The flat phase accounting grew into hierarchical span tracing
+# (ISSUE-10); PhaseTimer remains the name the rest of the repo
+# constructs. Tracer is a strict superset: ``.phase(name)`` context
+# manager, writable ``.phases`` dict, ``.report()`` — plus ``.span()``
+# nesting, ``.add_span()`` post-hoc intervals, and Chrome trace export.
+PhaseTimer = Tracer
 
 
 @contextlib.contextmanager

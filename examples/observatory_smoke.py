@@ -1,0 +1,181 @@
+"""``make observatory-smoke``: the live observatory end to end over HTTP.
+
+The CI-sized check of ISSUE-10's four layers against a REAL daemon:
+
+1. boot ``ServingDaemon`` on an ephemeral port, submit a run;
+2. stream ``GET /v1/progress/<id>`` while it executes — assert lifecycle
+   ordering (queued → running → … → done), at least one chunk heartbeat
+   with a finite gap, and monotone iteration indices;
+3. scrape ``GET /metrics`` mid-run and after — assert Prometheus text
+   with the executable-cache and serving families present and a
+   consistent histogram (bucket total == count) in the SAME scrape;
+4. pull the finished manifest, write it (plus a second run's) to a temp
+   dir, and drive the ``observatory`` CLI over it: ``list`` finds both,
+   ``compare`` reports the config diff, and ``perf-diff`` self-checks
+   the committed ``docs/perf`` tree (exit 0).
+
+Exit code 0 = all assertions passed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _get_json(url, timeout=300.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def main() -> int:
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+    from distributed_optimization_tpu.observability.observatory import main as obs_main
+
+    base = ExperimentConfig(
+        n_workers=8, n_samples=400, n_features=10,
+        n_informative_features=6, problem_type="quadratic",
+        n_iterations=200, eval_every=10, local_batch_size=8,
+    )
+    opts = ServingOptions(window_s=0.05, progress_every=2)
+    daemon = ServingDaemon(
+        "127.0.0.1", 0, opts,
+        service=SimulationService(opts, cache=ExecutableCache()),
+    )
+    daemon.start()
+    url = daemon.url
+    print(f"[observatory-smoke] daemon at {url}", file=sys.stderr)
+    try:
+        # --- submit and stream progress WHILE it runs -------------------
+        body = json.dumps(base.to_dict()).encode()
+        req = urllib.request.Request(
+            url + "/v1/submit", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            sub = json.loads(r.read())
+        rid = sub["id"]
+
+        # /metrics is scraped MID-RUN: on the first chunk heartbeat (the
+        # run is provably in flight), a second connection scrapes while
+        # this one keeps streaming — the torn-histogram check below runs
+        # on that snapshot.
+        mid_scrapes = []
+        events = []
+        with urllib.request.urlopen(
+            url + f"/v1/progress/{rid}?timeout=300", timeout=300
+        ) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "application/x-ndjson"
+            ), resp.headers["Content-Type"]
+            for line in resp:
+                events.append(json.loads(line))
+                if events[-1]["kind"] == "chunk" and not mid_scrapes:
+                    with urllib.request.urlopen(
+                        url + "/metrics", timeout=30
+                    ) as r:
+                        mid_scrapes.append(r.read().decode())
+
+        statuses = [e.get("status") for e in events if e.get("status")]
+        assert statuses[0] == "queued" and statuses[-1] == "done", statuses
+        chunks = [e for e in events if e["kind"] == "chunk"]
+        assert chunks, f"no chunk heartbeats streamed: {events}"
+        iters = [e["iteration"] for e in chunks]
+        assert iters == sorted(iters) and iters[-1] == base.n_iterations, iters
+        assert any(
+            isinstance(e.get("gap"), (int, float)) for e in chunks
+        ), chunks
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs), seqs
+        print(
+            f"[observatory-smoke] streamed {len(events)} events "
+            f"({len(chunks)} chunk heartbeats), lifecycle {statuses}",
+            file=sys.stderr,
+        )
+
+        # --- /metrics: families present + consistent histogram ----------
+        assert mid_scrapes and not mid_scrapes[0].startswith("ERROR"), (
+            mid_scrapes
+        )
+        text = mid_scrapes[0]
+        for family in (
+            "dopt_exec_cache_hits_total",
+            "dopt_serving_queue_depth",
+            "dopt_serving_cohort_size",
+            "dopt_progress_heartbeats_total",
+        ):
+            assert family in text, f"/metrics missing {family}\n{text}"
+        # No torn histogram: within ONE scrape, the +Inf cumulative bucket
+        # must equal the count line for every histogram series.
+        import re
+
+        for name in re.findall(r"# TYPE (\S+) histogram", text):
+            infs = {
+                m.group(1) or "": int(m.group(2))
+                for m in re.finditer(
+                    rf'^{name}_bucket\{{(.*?,)?le="\+Inf"\}} (\d+)$',
+                    text, re.M,
+                )
+            }
+            counts = re.findall(rf"^{name}_count(?:\{{.*\}})? (\d+)$", text, re.M)
+            if counts and infs:
+                assert sum(infs.values()) == sum(int(c) for c in counts), (
+                    f"torn histogram {name}: {infs} vs {counts}"
+                )
+
+        # --- status: counters always present + bounded history ----------
+        code, st = _get_json(url + "/v1/status")
+        assert code == 200
+        assert {"hits", "misses", "compile_seconds_saved"} <= set(st["cache"])
+        assert st["history"]["bound"] == opts.max_done
+        assert st["history"]["retained"] >= 1
+
+        # --- observatory CLI over the served manifests -------------------
+        code, m1 = _get_json(url + f"/v1/result/{rid}?timeout=60")
+        assert code == 200 and m1["kind"] == "run_trace"
+        assert m1["provenance"]["jax_version"], m1["provenance"]
+        assert m1["spans"], "manifest carries no spans"
+        req2 = urllib.request.Request(
+            url + "/v1/run?timeout=300",
+            data=json.dumps(
+                base.replace(learning_rate_eta0=0.11).to_dict()
+            ).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req2, timeout=300) as r:
+            m2 = json.loads(r.read())
+
+        with tempfile.TemporaryDirectory() as td:
+            a = Path(td) / "a.json"
+            b = Path(td) / "b.json"
+            a.write_text(json.dumps(m1))
+            b.write_text(json.dumps(m2))
+            assert obs_main(["list", td]) == 0
+            assert obs_main(["compare", str(a), str(b)]) == 0
+        repo = Path(__file__).resolve().parent.parent
+        rc = obs_main([
+            "perf-diff",
+            "--fresh", str(repo / "docs" / "perf"),
+            "--committed", str(repo / "docs" / "perf"),
+        ])
+        assert rc == 0, "perf-diff self-check failed"
+        print("[observatory-smoke] PASS", file=sys.stderr)
+        return 0
+    finally:
+        daemon.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

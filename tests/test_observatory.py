@@ -1,0 +1,569 @@
+"""Live-observatory tests (ISSUE-10; docs/OBSERVABILITY.md).
+
+Five guarantees are pinned here:
+
+1. PROGRESS OFF/ON bitwise parity — heartbeats ride segmented execution
+   of the same compiled program, so trajectories with a callback
+   installed are bitwise the one-shot run's on the sequential, chunked,
+   replica-batched, and async paths (and off is the pre-PR code path).
+2. Metrics registry semantics — Prometheus exposition shape, get-or-
+   create families, and CONSISTENT snapshots (a scrape racing concurrent
+   observes never sees a torn histogram).
+3. Span tracing — nesting, the PhaseTimer-compatible flat phase surface,
+   and Chrome trace-event export.
+4. Schema v2 provenance — git/jax/device facts in every manifest,
+   round-tripped, with v1 rejected.
+5. The serving progress streams and the observatory CLI (index /
+   compare / perf-diff) — including the poison-isolation satellite: a
+   failing request's stream terminates cleanly and does not stall a
+   healthy cohort's stream.
+"""
+
+import json
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+from conftest import small_backend_config as small_config
+
+from distributed_optimization_tpu import telemetry
+from distributed_optimization_tpu.backends import jax_backend
+from distributed_optimization_tpu.observability.metrics_registry import (
+    MetricsRegistry,
+)
+from distributed_optimization_tpu.observability.progress import (
+    ProgressEvent,
+    ProgressStream,
+    format_progress_line,
+)
+from distributed_optimization_tpu.observability.spans import Tracer
+from distributed_optimization_tpu.observability import observatory
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _setup(**kw):
+    cfg = small_config(n_iterations=40, eval_every=10, **kw)
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    return cfg, ds, f_opt
+
+
+# ------------------------------------------------- progress off/on parity
+
+
+def test_progress_off_on_bitwise_sequential():
+    cfg, ds, f_opt = _setup(edge_drop_prob=0.2)
+    off = jax_backend.run(cfg, ds, f_opt)
+    events = []
+    on = jax_backend.run(cfg, ds, f_opt, progress_cb=events.append)
+    np.testing.assert_array_equal(off.history.objective, on.history.objective)
+    np.testing.assert_array_equal(
+        off.history.consensus_error, on.history.consensus_error
+    )
+    np.testing.assert_array_equal(off.final_models, on.final_models)
+    iters = [e.iteration for e in events]
+    assert iters == [10, 20, 30, 40]
+    assert all(np.isfinite(e.gap) for e in events)
+    # Live B̂ under an active fault process: present and plausible.
+    assert all(e.bhat is not None and e.bhat >= 1 for e in events)
+    # Every-other-eval cadence still ends at the horizon.
+    ev2 = []
+    jax_backend.run(cfg, ds, f_opt, progress_cb=ev2.append, progress_every=3)
+    assert [e.iteration for e in ev2] == [30, 40]
+
+
+def test_progress_off_on_bitwise_chunked():
+    cfg, ds, f_opt = _setup()
+    off = jax_backend.run(cfg, ds, f_opt, measure_timestamps=True)
+    events = []
+    on = jax_backend.run(
+        cfg, ds, f_opt, measure_timestamps=True, progress_cb=events.append
+    )
+    np.testing.assert_array_equal(off.history.objective, on.history.objective)
+    assert len(events) == 4 and events[-1].iteration == 40
+    # Benign config: no fault process, so no live-B̂ claim.
+    assert all(e.bhat is None for e in events)
+    # The chunked loop honors the cadence contract like every other
+    # path: progress_every=3 over 4 eval-chunks -> chunk 3 + the final.
+    coarse = []
+    jax_backend.run(
+        cfg, ds, f_opt, measure_timestamps=True,
+        progress_cb=coarse.append, progress_every=3,
+    )
+    assert [e.iteration for e in coarse] == [30, 40]
+
+
+def test_progress_off_on_bitwise_batch():
+    cfg, ds, f_opt = _setup(straggler_prob=0.1)
+    off = jax_backend.run_batch(cfg.replace(replicas=3), ds, f_opt)
+    events = []
+    on = jax_backend.run_batch(
+        cfg.replace(replicas=3), ds, f_opt,
+        progress_cb=events.append, progress_every=3,
+    )
+    np.testing.assert_array_equal(off.objective, on.objective)
+    for r in range(3):
+        np.testing.assert_array_equal(
+            off.results[r].final_models, on.results[r].final_models
+        )
+    # Segment sizes 3 + remainder 1 -> heartbeats at evals 3 and 4.
+    assert [e.iteration for e in events] == [30, 40]
+    assert all(
+        e.gap_per_replica is not None and len(e.gap_per_replica) == 3
+        for e in events
+    )
+    assert events[-1].gap == pytest.approx(
+        float(np.mean(events[-1].gap_per_replica))
+    )
+
+
+def test_progress_off_on_bitwise_async():
+    cfg, ds, f_opt = _setup(
+        execution="async", latency_model="lognormal", latency_mean=1.0,
+        latency_tail=0.5,
+    )
+    off = jax_backend.run(cfg, ds, f_opt)
+    events = []
+    on = jax_backend.run(
+        cfg, ds, f_opt, progress_cb=events.append, progress_every=2
+    )
+    np.testing.assert_array_equal(off.history.objective, on.history.objective)
+    np.testing.assert_array_equal(off.final_models, on.final_models)
+    assert [e.iteration for e in events] == [20, 40]
+    n = cfg.n_workers
+    assert events[-1].event_index == 40 * n and events[-1].n_events == 40 * n
+    # Staleness quantiles over the executed window, ordered.
+    for e in events:
+        assert e.kind == "async"
+        assert 0 <= e.staleness_p50 <= e.staleness_p90 <= e.staleness_max
+
+
+def test_progress_composes_with_telemetry_and_checkpoint(tmp_path):
+    from distributed_optimization_tpu.utils.checkpoint import CheckpointOptions
+
+    cfg, ds, f_opt = _setup(edge_drop_prob=0.15)
+    tcfg = cfg.replace(telemetry=True)
+    plain = jax_backend.run(tcfg, ds, f_opt)
+    on = jax_backend.run(
+        tcfg, ds, f_opt, progress_cb=lambda e: None, progress_every=2
+    )
+    for k in telemetry.TRACE_FIELDS:
+        np.testing.assert_array_equal(
+            plain.history.trace[k], on.history.trace[k]
+        )
+    # Checkpoint + progress: the segmented runner serves both at once.
+    events = []
+    ck = jax_backend.run(
+        cfg, ds, f_opt,
+        checkpoint=CheckpointOptions(directory=str(tmp_path), every_evals=2),
+        progress_cb=events.append,
+    )
+    base = jax_backend.run(cfg, ds, f_opt)
+    np.testing.assert_array_equal(
+        base.history.objective, ck.history.objective
+    )
+    assert [e.iteration for e in events] == [20, 40]
+
+
+def test_progress_broken_callback_does_not_kill_run():
+    cfg, ds, f_opt = _setup()
+
+    def boom(_ev):
+        raise RuntimeError("observer crashed")
+
+    r = jax_backend.run(cfg, ds, f_opt, progress_cb=boom)
+    assert np.isfinite(r.history.objective[-1])
+
+
+def test_progress_every_validated():
+    cfg, ds, f_opt = _setup()
+    with pytest.raises(ValueError, match="progress_every"):
+        jax_backend.run(
+            cfg, ds, f_opt, progress_cb=lambda e: None, progress_every=0
+        )
+
+
+# ------------------------------------------------------- metrics registry
+
+
+def test_registry_render_and_get_or_create():
+    reg = MetricsRegistry()
+    c = reg.counter("dopt_x_total", "things")
+    c.inc()
+    c.inc(2, status="done")
+    assert reg.counter("dopt_x_total") is c  # get-or-create
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("dopt_x_total")
+    g = reg.gauge_fn("dopt_depth", "d", lambda: 7)
+    h = reg.histogram("dopt_h", "h", buckets=(1, 2))
+    h.observe(1.5)
+    text = reg.render()
+    assert "# TYPE dopt_x_total counter" in text
+    assert "dopt_x_total 1" in text
+    assert 'dopt_x_total{status="done"} 2' in text
+    assert "dopt_depth 7" in text
+    assert 'dopt_h_bucket{le="2"} 1' in text
+    assert "dopt_h_count 1" in text
+    # gauge_fn re-registration replaces the callback (newest owner wins).
+    reg.gauge_fn("dopt_depth", "d", lambda: 9)
+    assert "dopt_depth 9" in reg.render()
+    assert g.value() == 9
+    # An EMPTY histogram still renders its full zero bucket shape —
+    # bare _sum/_count with no _bucket lines is invalid exposition and
+    # strict scrapers reject the whole payload (the cold-daemon state).
+    reg.histogram("dopt_cold", "never observed", buckets=(1, 2))
+    cold = reg.render()
+    assert 'dopt_cold_bucket{le="1"} 0' in cold
+    assert 'dopt_cold_bucket{le="+Inf"} 0' in cold
+    assert "dopt_cold_count 0" in cold
+
+
+def test_registry_no_torn_histogram_under_concurrency():
+    """A scrape racing concurrent observes must always see bucket counts
+    that sum to _count and a _sum from the same instant — the consistent-
+    snapshot guarantee the /metrics satellite asks for."""
+    reg = MetricsRegistry()
+    h = reg.histogram("dopt_t", "t", buckets=(0.5,))
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            h.observe(0.25)
+            h.observe(0.75)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            snap = reg.snapshot()["dopt_t"]["series"][""]
+            assert sum(snap["bucket_counts"]) == snap["count"]
+            # Equal mass in each bucket by construction — and the sum
+            # must be exactly consistent with the counts seen.
+            assert snap["sum"] == pytest.approx(
+                0.25 * snap["bucket_counts"][0]
+                + 0.75 * snap["bucket_counts"][1]
+            )
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+# ----------------------------------------------------------------- spans
+
+
+def test_tracer_nesting_and_phase_compat():
+    from distributed_optimization_tpu.utils.profiling import PhaseTimer
+
+    assert PhaseTimer is Tracer  # the flat timer grew into the span tracer
+    t = Tracer()
+    with t.phase("outer"):
+        with t.span("inner"):
+            pass
+        t.add_span("post_hoc", 0.5)
+    t.phases["manual"] = 1.0  # the writable-dict surface stays
+    spans = {s["name"]: s for s in t.spans()}
+    assert spans["inner"]["parent"] == spans["outer"]["id"]
+    assert spans["post_hoc"]["parent"] == spans["outer"]["id"]
+    assert t.phases["post_hoc"] == 0.5 and "outer" in t.phases
+    assert "manual" in t.report()
+    trace = t.to_chrome_trace()
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in evs} == {"outer", "inner", "post_hoc"}
+    for e in evs:
+        assert e["dur"] >= 0 and "ts" in e and "pid" in e
+    # aggregate=False records the span but not the phase seconds.
+    t2 = Tracer()
+    with t2.span("group", aggregate=False):
+        pass
+    assert "group" not in t2.phases
+    assert any(s["name"] == "group" for s in t2.spans())
+
+
+# ------------------------------------------------------ progress stream
+
+
+def test_progress_stream_follow_and_replay():
+    s = ProgressStream(capacity=3)
+    for i in range(5):
+        s.publish(ProgressEvent(
+            kind="chunk", iteration=i, n_iterations=5, wall_seconds=0.0,
+        ))
+    # Capacity bound: only the newest 3 replay; seq survives eviction.
+    assert [e["seq"] for e in s.events()] == [2, 3, 4]
+    assert [e["seq"] for e in s.events(after_seq=3)] == [4]
+    got = []
+    follower = threading.Thread(
+        target=lambda: got.extend(s.follow(after_seq=2, timeout=10))
+    )
+    follower.start()
+    s.publish(ProgressEvent(
+        kind="lifecycle", iteration=5, n_iterations=5, wall_seconds=0.0,
+        status="done",
+    ))
+    s.close()
+    follower.join(timeout=10)
+    assert not follower.is_alive()
+    assert [e["seq"] for e in got] == [3, 4, 5]
+    assert got[-1]["status"] == "done"
+    # to_dict drops Nones; the line formatter stays total.
+    assert "gap" not in got[0]
+    assert "iter" in format_progress_line(
+        ProgressEvent(kind="chunk", iteration=1, n_iterations=2,
+                      wall_seconds=0.1)
+    )
+
+
+# -------------------------------------------------- provenance / schema v2
+
+
+def test_provenance_facts_and_roundtrip():
+    prov = telemetry.provenance(refresh=True)
+    assert prov["jax_version"]  # jax is importable here by construction
+    assert prov["device_kind"]
+    assert prov["git_sha"] and len(prov["git_sha"]) == 40  # repo is a git tree
+    assert isinstance(prov["git_dirty"], bool)
+
+    cfg, ds, f_opt = _setup()
+    r = jax_backend.run(cfg, ds, f_opt)
+    tracer = Tracer()
+    with tracer.phase("run"):
+        pass
+    tr = telemetry.build_run_trace("unit", cfg, r.history, phases=tracer)
+    assert tr.schema_version == telemetry.SCHEMA_VERSION == 2
+    assert tr.provenance == prov
+    assert tr.spans and tr.spans[0]["name"] == "run"
+    again = telemetry.RunTrace.from_json(tr.to_json())
+    assert again.to_dict() == tr.to_dict()
+    # v1 manifests (pre-provenance) are rejected by the v2 reader.
+    d1 = tr.to_dict()
+    d1.pop("provenance")
+    d1.pop("spans")
+    d1["schema_version"] = 1
+    with pytest.raises(ValueError, match="missing keys"):
+        telemetry.RunTrace.from_dict(d1)
+
+
+def test_bench_manifest_carries_provenance_and_spans(tmp_path):
+    cfg, _, _ = _setup()
+    tracer = Tracer()
+    with tracer.phase("bench"):
+        pass
+    art = tmp_path / "thing.json"
+    art.write_text("{}")
+    out = telemetry.write_bench_manifest(art, config=cfg, phases=tracer)
+    blob = json.loads(out.read_text())
+    assert set(blob) == set(telemetry.BENCH_MANIFEST_KEYS)
+    assert blob["schema_version"] == 2
+    assert blob["provenance"]["jax_version"]
+    assert blob["spans"] and blob["spans"][0]["name"] == "bench"
+
+
+# ---------------------------------------------------- serving progress
+
+
+def _serving_cfg(**kw):
+    base = dict(
+        n_workers=8, n_samples=400, n_features=10,
+        n_informative_features=6, problem_type="quadratic",
+        n_iterations=40, eval_every=10, local_batch_size=8,
+    )
+    base.update(kw)
+    from distributed_optimization_tpu.config import ExperimentConfig
+
+    return ExperimentConfig(**base)
+
+
+def test_service_streams_lifecycle_and_chunk_heartbeats():
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    opts = ServingOptions(window_s=0.0, progress_every=1)
+    svc = SimulationService(opts, cache=ExecutableCache())
+    rid = svc.submit(_serving_cfg())
+    svc.drain()
+    req = svc.result(rid, timeout=60)
+    assert req.status == "done"
+    events = list(req.progress.follow(timeout=5))
+    statuses = [e.get("status") for e in events if e.get("status")]
+    assert statuses[0] == "queued" and statuses[-1] == "done"
+    assert "running" in statuses
+    chunks = [e for e in events if e["kind"] == "chunk"]
+    assert [e["iteration"] for e in chunks] == [10, 20, 30, 40]
+    # Coalesced cohort: each member's stream carries ITS replica's gap.
+    ids = [
+        svc.submit(_serving_cfg(learning_rate_eta0=e))
+        for e in (0.05, 0.08)
+    ]
+    svc.drain()
+    gaps = {}
+    for rid2 in ids:
+        req2 = svc.result(rid2, timeout=60)
+        evs = list(req2.progress.follow(timeout=5))
+        cks = [e for e in evs if e["kind"] == "chunk"]
+        assert cks and all("gap_per_replica" not in e for e in cks)
+        assert cks[0]["extra"]["cohort_size"] == 2
+        gaps[rid2] = cks[-1]["gap"]
+    assert gaps[ids[0]] != gaps[ids[1]]  # per-member values, not the mean
+
+
+def test_poison_request_stream_terminates_and_does_not_stall_others():
+    """Satellite 3: a poisoned request fails ALONE with a terminal
+    'failed' lifecycle event and a CLOSED stream; a healthy cohort cut in
+    the same pass completes and its follower — started BEFORE execution —
+    unblocks with the full heartbeat sequence rather than hanging."""
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    opts = ServingOptions(window_s=0.0, progress_every=1)
+    svc = SimulationService(opts, cache=ExecutableCache())
+    good = svc.submit(_serving_cfg())
+    poison = svc.submit(_serving_cfg(
+        attack="sign_flip", n_byzantine=1, aggregation="trimmed_mean",
+        robust_b=3, partition="shuffled",  # 2*3 > ring min degree 2
+    ))
+    good_events: list = []
+    follower = threading.Thread(
+        target=lambda: good_events.extend(
+            svc.get(good).progress.follow(timeout=60)
+        )
+    )
+    follower.start()
+    svc.drain()
+    follower.join(timeout=60)
+    assert not follower.is_alive(), "healthy stream stalled"
+    assert [e.get("status") for e in good_events if e.get("status")][-1] == (
+        "done"
+    )
+    assert any(e["kind"] == "chunk" for e in good_events)
+    preq = svc.result(poison, timeout=60)
+    assert preq.status == "failed"
+    p_events = list(preq.progress.follow(timeout=5))
+    assert p_events[-1]["status"] == "failed"
+    assert preq.progress.closed
+    # The service keeps accepting and serving after the poison plan.
+    again = svc.submit(_serving_cfg())
+    svc.drain()
+    assert svc.result(again, timeout=60).status == "done"
+
+
+def test_status_counters_always_present_and_history_bounded():
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    svc = SimulationService(
+        ServingOptions(window_s=0.0), cache=ExecutableCache()
+    )
+    st = svc.stats()  # BEFORE any work: full shape, zeros
+    assert st["cache"]["hits"] == 0 and st["cache"]["misses"] == 0
+    assert st["cache"]["compile_seconds_saved"] == 0.0
+    assert st["cohorts"]["count"] == 0
+    assert st["history"] == {
+        "bound": svc.options.max_done, "retained": 0, "recent": [],
+    }
+    rid = svc.submit(_serving_cfg())
+    svc.drain()
+    st = svc.stats()
+    assert st["history"]["retained"] == 1
+    assert st["history"]["recent"][0]["id"] == rid
+    assert st["cache"]["misses"] >= 1
+
+
+# ------------------------------------------------------- observatory CLI
+
+
+def _write_manifests(tmp_path):
+    cfg, ds, f_opt = _setup()
+    r1 = jax_backend.run(cfg, ds, f_opt)
+    r2 = jax_backend.run(cfg.replace(learning_rate_eta0=0.11), ds, f_opt)
+    t1 = telemetry.build_run_trace(
+        "run-a", cfg, r1.history,
+        health=telemetry.health_summary(cfg, r1.history),
+    )
+    t2 = telemetry.build_run_trace(
+        "run-b", cfg.replace(learning_rate_eta0=0.11), r2.history,
+        health=telemetry.health_summary(
+            cfg.replace(learning_rate_eta0=0.11), r2.history
+        ),
+    )
+    telemetry.write_jsonl(tmp_path / "runs.jsonl", [t1, t2])
+    art = tmp_path / "bench.json"
+    art.write_text("{}")
+    telemetry.write_bench_manifest(art, config=cfg)
+    return cfg, t1, t2
+
+
+def test_observatory_index_and_filters(tmp_path):
+    cfg, t1, t2 = _write_manifests(tmp_path)
+    recs = observatory.build_index(tmp_path)
+    kinds = sorted(r.kind for r in recs)
+    assert kinds == ["bench_manifest", "run_trace", "run_trace"]
+    # Structural filter: eta0 is sweepable, so BOTH runs share the
+    # serving-cohort structural hash and the filter returns both.
+    sh = cfg.structural_hash()
+    both = observatory.build_index(tmp_path, structural_hash=sh)
+    assert sorted(r.label for r in both if r.kind == "run_trace") == [
+        "run-a", "run-b",
+    ]
+    # Full-config-hash filter: the bench sidecar was written with cfg
+    # itself, so it shares run-a's config_hash — "all evidence for this
+    # exact config" returns both; kind= narrows to the trace.
+    same_cfg = observatory.build_index(tmp_path, config_hash=t1.config_hash)
+    assert sorted(r.label for r in same_cfg) == ["bench.json", "run-a"]
+    only_a = observatory.build_index(
+        tmp_path, config_hash=t1.config_hash, kind="run_trace"
+    )
+    assert [r.label for r in only_a] == ["run-a"]
+    assert all(r.git_sha for r in recs)  # provenance indexed
+
+
+def test_observatory_compare(tmp_path):
+    _, t1, t2 = _write_manifests(tmp_path)
+    diff = observatory.compare_manifests(t1.to_dict(), t2.to_dict())
+    assert diff["structural_match"] is True
+    assert diff["same_config_hash"] is False
+    assert set(diff["config_diff"]) == {"learning_rate_eta0"}
+    assert diff["headline"]["final_gap"]["b_over_a"] is not None
+    # CLI surface: jsonl line addressing + exit code.
+    assert observatory.main([
+        "compare", f"{tmp_path}/runs.jsonl:0", f"{tmp_path}/runs.jsonl:1",
+    ]) == 0
+
+
+def test_perf_diff_self_check_and_regression(tmp_path):
+    committed = REPO / "docs" / "perf"
+    ok = observatory.perf_diff(committed, committed)
+    assert ok["ok"], ok
+    # Inject a regression: flip an asserted gate boolean in a fresh copy.
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    for p in committed.glob("*.json"):
+        (fresh / p.name).write_text(p.read_text())
+    blob = json.loads((fresh / "telemetry.json").read_text())
+    blob["gates"]["off_on_bitwise_objective"] = False
+    (fresh / "telemetry.json").write_text(json.dumps(blob))
+    bad = observatory.perf_diff(fresh, committed)
+    assert not bad["ok"]
+    assert bad["artifacts"]["telemetry.json"]["status"] == "regressed"
+    assert observatory.main([
+        "perf-diff", "--fresh", str(fresh), "--committed", str(committed),
+    ]) == 1
+    # A missing fresh artifact is visible but not a regression (partial
+    # regen sessions restrict with --artifact).
+    (fresh / "churn.json").unlink()
+    part = observatory.perf_diff(fresh, committed, artifacts=["churn.json"])
+    assert part["artifacts"]["churn.json"]["status"] == "missing"

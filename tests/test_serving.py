@@ -431,7 +431,13 @@ def test_kill_switch_serves_uncached(monkeypatch):
     # Identical repeats still coalesce (one cohort, one compile) — but
     # nothing is cached across plans and no hit is claimed.
     assert all(r.status == "done" and r.cache_hit is None for r in reqs)
-    assert svc.stats()["cache"] == {"disabled": True}
+    # The status shape contract (ISSUE-10 satellite): even with the cache
+    # disabled, the counter block keeps its full shape — zeros, plus the
+    # disabled flag — so dashboards never special-case a cold daemon.
+    cache_stats = svc.stats()["cache"]
+    assert cache_stats["disabled"] is True
+    assert cache_stats["hits"] == 0 and cache_stats["misses"] == 0
+    assert cache_stats["compile_seconds_saved"] == 0.0
 
 
 def test_poison_request_does_not_kill_inflight_cohorts():
