@@ -7,7 +7,7 @@ PY ?= python
 	bench-byzantine bench-churn \
 	bench-robust-scale bench-sweep bench-compute bench-telemetry \
 	bench-fused bench-serving bench-federated bench-async \
-	bench-observatory
+	bench-observatory bench-mesh
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -27,7 +27,8 @@ smoke:
 		tests/test_compressed_gossip.py tests/test_batch.py \
 		tests/test_telemetry.py tests/test_serving.py \
 		tests/test_federated.py tests/test_async.py \
-		tests/test_matrix_free_faults.py tests/test_observatory.py
+		tests/test_matrix_free_faults.py tests/test_observatory.py \
+		tests/test_worker_mesh.py
 	$(MAKE) observatory-smoke
 
 # End-to-end live-observatory smoke over real HTTP (docs/OBSERVABILITY.md):
@@ -122,3 +123,11 @@ bench-serving:
 # bitwise gate, async-path cell, /metrics scrape p95 under load).
 bench-observatory:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_observatory.py
+
+# Regenerate the sharded worker-mesh evidence (docs/perf/worker_mesh.json:
+# sharded-vs-unsharded bitwise parity, the N=100k completion over 4
+# forced host devices, flat per-device memory at matched rows/device,
+# N-independent ring ICI bytes — the script forces the 4-device host
+# platform itself).
+bench-mesh:
+	$(PY) examples/bench_worker_mesh.py

@@ -491,7 +491,7 @@ def _flat_scan_cadence(scan_unroll: int, eval_every: int):
 
 
 def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
-                  timeline=None, horizon=None):
+                  timeline=None, horizon=None, halo_mesh=None):
     """Time-varying gossip wiring shared by ``_run`` and ``run_batch``.
 
     Returns a ``FaultyMixing`` (or None for a static graph) after the
@@ -499,7 +499,10 @@ def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
     batched hooks: ``drop_prob`` a per-replica (possibly traced) scalar,
     ``keys`` pre-derived per-replica PRNG keys, ``timeline`` a prebuilt
     per-replica ``FaultTimeline`` view, ``horizon`` the timeline length
-    (t0 + T for continued batches; defaults to T).
+    (t0 + T for continued batches; defaults to T). ``halo_mesh``: the
+    worker-mesh route (``config.worker_mesh >= 2``) — node-process fault
+    mixing then runs sharded with per-shard timeline slices
+    (``parallel/faults.py::make_halo_faulty_mixing``).
     """
     time_varying = (
         config.edge_drop_prob > 0.0
@@ -544,12 +547,13 @@ def _build_faulty(config, algo, topo, T, *, drop_prob=None, keys=None,
         horizon=T if horizon is None else horizon,
         keys=keys, timeline=timeline,
         participation_rate=config.participation_rate,
+        mesh=halo_mesh,
     )
 
 
 def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
                     byz=None, noise_key=None, allow_fused=True,
-                    fused_auto_ok=True):
+                    fused_auto_ok=True, halo_mesh=None):
     """Byzantine adversary + robust-aggregation wiring shared by ``_run``
     and ``run_batch`` (docs/BYZANTINE.md). Returns ``(adversary, byz_mix,
     activity_t, fused_step_t)`` — all None when the config is benign.
@@ -656,7 +660,29 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
                 f"resolved robust_impl={robust_impl!r} needs the dense "
                 "[N, N] adjacency"
             )
-        if robust_impl in ("gather", "fused"):
+        if halo_mesh is not None:
+            # Sharded worker mesh (docs/PERF.md §16): screening runs in
+            # halo-gather form — corrupted boundary rows travel over the
+            # same ppermute exchange as benign gossip, each shard screens
+            # its own closed neighborhoods locally. Node-process faults
+            # compose through the availability row; config already
+            # rejected everything without a sharded form (edge chains,
+            # alie, dense/fused impls, the telemetry activity probe)
+            # with the missing piece named.
+            if robust_impl != "gather":
+                raise ValueError(
+                    f"worker_mesh screens in halo-gather form; resolved "
+                    f"robust_impl={robust_impl!r} has no sharded twin"
+                )
+            from distributed_optimization_tpu.parallel.collectives import (
+                make_halo_robust_aggregator_t,
+            )
+
+            robust_aggregate_t = make_halo_robust_aggregator_t(
+                config.aggregation, config.robust_b, topo, halo_mesh,
+                ct, faulty.active if faulty is not None else None,
+            )
+        elif robust_impl in ("gather", "fused"):
             from distributed_optimization_tpu.parallel.topology import (
                 neighbor_tables_for,
             )
@@ -1313,29 +1339,74 @@ def _run(
     d_model = problem.param_dim(device_data.n_features)
 
     # --- topology & collectives (centralized needs none) ---
+    halo_mesh = None
     if algo.is_decentralized:
         topo = build_topology(
             config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
             seed=config.resolved_topology_seed(),
             impl=config.resolved_topology_impl(),
         )
-        if (
+        if config.worker_mesh >= 2:
+            # Sharded worker mesh (ISSUE-11 tentpole, docs/PERF.md §16):
+            # exactly config.worker_mesh devices, contiguous row blocks.
+            # The halo-exchange gather path IS the mixing operator; state,
+            # data, and timeline columns shard over the same mesh below.
+            if mesh is not None:
+                from distributed_optimization_tpu.parallel.mesh import (
+                    WORKER_AXIS as _WAXIS,
+                )
+
+                if (
+                    _WAXIS not in mesh.shape
+                    or mesh.shape[_WAXIS] != config.worker_mesh
+                    or mesh.size != config.worker_mesh
+                ):
+                    raise ValueError(
+                        f"worker_mesh={config.worker_mesh} needs a 1-D "
+                        f"mesh with a {_WAXIS!r} axis of exactly that "
+                        f"size (the halo plan, timeline slices and ICI "
+                        f"accounting are all built for that P); got "
+                        f"axes {dict(mesh.shape)}"
+                    )
+            else:
+                from distributed_optimization_tpu.parallel.mesh import (
+                    make_sized_worker_mesh,
+                )
+
+                mesh = make_sized_worker_mesh(config.worker_mesh)
+            halo_mesh = mesh
+            from distributed_optimization_tpu.parallel.collectives import (
+                make_halo_mixing_op,
+            )
+
+            mix_op = make_halo_mixing_op(
+                topo, mesh, dtype=device_data.X.dtype
+            )
+        elif (
             mesh is None and use_mesh and len(jax.devices()) > 1
             and not topo.is_matrix_free
         ):
-            # The shard_map grid stencil blocks grid ROWS over devices, so the
-            # mesh size must divide the row count, not just N. The
-            # matrix-free path runs unsharded: its regime is the huge-N
-            # single-process simulation (the replica axis fills the chip),
-            # and gather indices under GSPMD would all-gather anyway.
-            if config.mixing_impl == "shard_map" and topo.grid_shape is not None:
+            # The shard_map grid stencil — and the GSPMD grid stencil the
+            # auto path resolves to — block grid ROWS over devices, so the
+            # mesh size must divide the row count, not just N (the
+            # ISSUE-11 satellite: auto and explicit shard_map now apply
+            # the SAME row-divisibility rule, so both resolve to the same
+            # mesh instead of auto landing on a device count the row
+            # reshape cannot split). The matrix-free path runs unsharded
+            # unless worker_mesh asks for the halo route above: gather
+            # indices under plain GSPMD would all-gather.
+            if topo.grid_shape is not None and config.mixing_impl in (
+                "shard_map", "stencil", "auto"
+            ):
                 mesh = make_worker_mesh(topo.grid_shape[0])
             else:
                 mesh = make_worker_mesh(n)
         # No platform-specific resolution (see the mixing-impl history note
         # above the run() helpers): make_mixing_op resolves 'auto'.
         mixing_impl = config.mixing_impl
-        if mixing_impl == "shard_map":
+        if halo_mesh is not None:
+            pass  # the halo gather op above IS the resolved mixing form
+        elif mixing_impl == "shard_map":
             if mesh is None:
                 raise ValueError("shard_map mixing requires a device mesh")
             mix_op = make_shard_map_mixing_op(topo, mesh)
@@ -1385,7 +1456,7 @@ def _run(
         # robust rule with a positive budget to defend with; robust_b == 0
         # keeps the plain gossip path bitwise (a robust rule degrades to
         # MH gossip at zero budget by definition).
-        faulty = _build_faulty(config, algo, topo, T)
+        faulty = _build_faulty(config, algo, topo, T, halo_mesh=halo_mesh)
         adversary, byz_mix, robust_activity, fused_robust_step = (
             _bind_byzantine(
                 config, algo, topo, faulty, mix_op,
@@ -1394,11 +1465,51 @@ def _run(
                 # pallas call (no partitioning rule) where the gather
                 # ops shard — explicit robust_impl='fused' still runs.
                 fused_auto_ok=mesh is None,
+                halo_mesh=halo_mesh,
             )
         )
         # == adjacency.sum() for both orientations; degree-based so the
         # matrix-free representation needs no [N, N] array.
         static_degree_sum = float(np.asarray(topo.degrees).sum())
+        if halo_mesh is not None:
+            # Real-collective traffic accounting (ISSUE-11): the halo
+            # plan is static, so bytes over ICI per device per round are
+            # exact — surfaced as per-device gauges in the PR-10 metrics
+            # registry (scraped at /metrics). One pricing source:
+            # ``telemetry.ici_summary`` (also the report's bytes-over-ICI
+            # line), fed the already-built topology per its one-build
+            # convention, so /metrics and the report can never disagree.
+            from distributed_optimization_tpu.observability.metrics_registry import (  # noqa: E501
+                metrics_registry,
+            )
+            from distributed_optimization_tpu.telemetry import ici_summary
+
+            _ici = ici_summary(
+                config, topo=topo, d_features=device_data.n_features
+            )
+            _reg = metrics_registry()
+            _g = _reg.gauge(
+                "dopt_worker_mesh_ici_bytes_per_round",
+                "Halo-exchange bytes each device ships per gossip round "
+                "(static plan: rotation-padded wire rows x per-config "
+                "row payload)",
+            )
+            _g.reset()  # a smaller mesh must not leave stale devices
+            for _p, _bytes in enumerate(
+                _ici["bytes_per_device_per_round"]
+            ):
+                _g.set(float(_bytes), device=str(_p))
+            _reg.gauge(
+                "dopt_worker_mesh_devices",
+                "Worker-mesh shard count of the most recent sharded run",
+            ).set(float(config.worker_mesh))
+            _halo_g = _reg.gauge(
+                "dopt_worker_mesh_halo_rows",
+                "Boundary rows each device fetches per gossip round",
+            )
+            _halo_g.reset()
+            for _p, _rows in enumerate(_ici["halo_rows_per_device"]):
+                _halo_g.set(float(_rows), device=str(_p))
     else:
         if (
             config.edge_drop_prob > 0.0
@@ -2021,6 +2132,13 @@ def batch_unsupported_reason(config) -> Optional[str]:
             "per seed, and the per-replica schedules have different "
             "event ORDERS (the order is data, but the staleness replay "
             "is not) — run seeds sequentially"
+        )
+    if config.worker_mesh >= 2:
+        return (
+            "run_batch and worker_mesh are mutually exclusive: the "
+            "replica axis vmaps one unsharded program (it fills the chip "
+            "instead of the worker mesh), and the halo-exchange shard_map "
+            "pins a fixed device mesh — run sharded seeds sequentially"
         )
     return None
 

@@ -336,6 +336,19 @@ def build_parser() -> argparse.ArgumentParser:
                             "combination: softmax + dsgd + ring + full "
                             "local batches — anything else is rejected "
                             "with the reason). 1 = pure data parallelism")
+    execg.add_argument("--worker-mesh", type=int,
+                       default=_DEFAULTS.worker_mesh, metavar="P",
+                       help="shard the WORKER axis over P devices "
+                            "(docs/PERF.md §16): state rows [N/P, d] and "
+                            "neighbor tables [N/P, k_max] live per-shard, "
+                            "gossip becomes a ppermute halo exchange at "
+                            "shard edges, and trajectories stay bitwise "
+                            "the unsharded gather path's. P must divide "
+                            "n-workers; jax backend + neighbor-table "
+                            "topologies (ring/grid/chain/erdos_renyi). On "
+                            "CPU hosts simulate P devices via XLA_FLAGS="
+                            "'--xla_force_host_platform_device_count=P'. "
+                            "0 = unsharded")
     execg.add_argument("--eval-every", type=int, default=_DEFAULTS.eval_every,
                        help="full-data objective eval cadence (1 = reference "
                             "parity)")
@@ -493,6 +506,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         data_seed=args.data_seed,
         replicas=args.replicas,
         tp_degree=args.tp,
+        worker_mesh=args.worker_mesh,
         eval_every=args.eval_every,
         erdos_renyi_p=args.erdos_renyi_p,
         edge_drop_prob=args.edge_drop_prob,

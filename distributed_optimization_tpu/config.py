@@ -396,6 +396,22 @@ class ExperimentConfig:
     # batches only; every other combination is rejected below with the
     # reason). 1 = pure data parallelism (unchanged).
     tp_degree: int = 1
+    # Sharded worker mesh (docs/PERF.md §16): split the WORKER axis into
+    # this many contiguous row blocks, one per device — state rows
+    # [N/P, d], neighbor tables [N/P, k_max] and fault-timeline columns
+    # all live per-shard, and each gossip round exchanges only the
+    # boundary rows a shard's neighbor table references (a ppermute halo
+    # exchange; parallel/collectives.py::make_halo_mixing_op). This is
+    # the representation that lifts matrix-free N past one device's RAM:
+    # per-device memory is O(N/P·(d + k_max)), and the sharded-vs-
+    # unsharded trajectories are BITWISE identical at matched N (the
+    # halo gather computes the exact per-row op sequence of the
+    # single-device gather path). 0 = unsharded (every pre-mesh
+    # program, unchanged); >= 2 = the device count, which must divide
+    # n_workers. jax backend + neighbor-table topologies only; on CPU
+    # hosts simulate devices via
+    # XLA_FLAGS=--xla_force_host_platform_device_count=P.
+    worker_mesh: int = 0
 
     def __post_init__(self) -> None:
         if self.problem_type not in PROBLEM_TYPES:
@@ -698,7 +714,12 @@ class ExperimentConfig:
                     f"topology_impl='neighbor' never materializes the "
                     f"[N, N] matrices that mixing_impl="
                     f"{self.mixing_impl!r} consumes — use 'auto', "
-                    "'gather', or 'stencil'"
+                    "'gather', or 'stencil'. To run the gather path over "
+                    "real collectives, shard the worker axis instead: "
+                    "worker_mesh >= 2 lowers gather mixing to a ppermute "
+                    "halo exchange (the sharded-gather path; "
+                    "docs/PERF.md §16) — mixing_impl='shard_map' is the "
+                    "dense-representation stencil form only"
                 )
             if (
                 self.attack != "none"
@@ -726,6 +747,126 @@ class ExperimentConfig:
                     "topology_impl='neighbor' does not compose with "
                     "tp_degree > 1 (the TP path pins its own ring "
                     "stencil over a device mesh)"
+                )
+        if self.worker_mesh < 0 or self.worker_mesh == 1:
+            raise ValueError(
+                f"worker_mesh must be 0 (unsharded) or >= 2 devices, got "
+                f"{self.worker_mesh} (1 would name the unsharded program "
+                "— leave it 0)"
+            )
+        if self.worker_mesh >= 2:
+            if self.backend != "jax":
+                raise ValueError(
+                    "worker_mesh shards the worker axis over a jax device "
+                    f"mesh; backend={self.backend!r} has no mesh — use "
+                    "backend='jax'"
+                )
+            if self.algorithm == "centralized":
+                raise ValueError(
+                    "worker_mesh shards the gossip neighbor tables; the "
+                    "centralized pattern has no peer graph to shard — it "
+                    "applies to decentralized algorithms only"
+                )
+            if self.n_workers % self.worker_mesh != 0:
+                raise ValueError(
+                    f"worker_mesh={self.worker_mesh} must divide n_workers "
+                    f"({self.n_workers}): shards are equal contiguous row "
+                    "blocks (pad N or pick a divisor)"
+                )
+            if self.topology not in NEIGHBOR_TOPOLOGIES:
+                raise ValueError(
+                    f"worker_mesh runs the neighbor-table halo-exchange "
+                    f"path; topology {self.topology!r} has no matrix-free "
+                    f"constructor (supported: {NEIGHBOR_TOPOLOGIES})"
+                )
+            if self.topology_impl == "dense":
+                raise ValueError(
+                    "worker_mesh shards the [N, k_max] neighbor tables; "
+                    "topology_impl='dense' materializes the [N, N] "
+                    "matrices the sharded path never builds — use "
+                    "'auto' or 'neighbor'"
+                )
+            if self.mixing_impl not in ("auto", "gather"):
+                raise ValueError(
+                    f"worker_mesh lowers gather mixing to a ppermute halo "
+                    f"exchange at shard edges; mixing_impl="
+                    f"{self.mixing_impl!r} has no sharded form — use "
+                    "'auto' or 'gather'"
+                )
+            if self.execution == "async":
+                raise ValueError(
+                    "worker_mesh does not compose with execution='async': "
+                    "the event path is a totally ordered sequential "
+                    "schedule a worker mesh cannot partition"
+                )
+            if self.gossip_schedule != "synchronous":
+                raise ValueError(
+                    "worker_mesh requires gossip_schedule='synchronous' "
+                    "(matching schedules sample partners from the dense "
+                    "adjacency)"
+                )
+            if self.edge_drop_prob > 0.0:
+                raise ValueError(
+                    "worker_mesh does not yet compose with per-edge fault "
+                    "processes (edge_drop_prob/burst_len): the missing "
+                    "piece is per-shard slicing of the [horizon, E] edge "
+                    "chains through shard-local (node, slot) -> edge-id "
+                    "tables — node processes (stragglers, churn, "
+                    "participation) compose through the halo today"
+                )
+            if self.attack == "alie":
+                raise ValueError(
+                    "worker_mesh does not compose with attack='alie': the "
+                    "colluders' shared payload is a global honest-moment "
+                    "reduction whose sharded accumulation order diverges "
+                    "from the single-device stream, breaking the bitwise "
+                    "parity contract — use sign_flip or large_noise"
+                )
+            if self.rejoin == "neighbor_restart":
+                raise ValueError(
+                    "worker_mesh does not yet compose with "
+                    "rejoin='neighbor_restart': the missing piece is the "
+                    "halo-averaged warm restart (the rejoin average needs "
+                    "boundary rows) — use rejoin='frozen'"
+                )
+            if self.robust_impl not in ("auto", "gather"):
+                raise ValueError(
+                    f"worker_mesh screens Byzantine messages in halo-"
+                    f"gather form over the sharded tables; robust_impl="
+                    f"{self.robust_impl!r} materializes dense/VMEM "
+                    "objects the sharded path never builds — use 'auto' "
+                    "or 'gather'"
+                )
+            if self.telemetry and (
+                self.aggregation != "gossip" and self.robust_b > 0
+            ):
+                raise ValueError(
+                    "worker_mesh does not yet compose with the telemetry "
+                    "robust-activity probe: the missing piece is a "
+                    "shard-local screening-fraction twin (the unsharded "
+                    "probe gathers the global [N, k_max, d] stack) — "
+                    "record telemetry without a robust rule, or run the "
+                    "robust study unsharded"
+                )
+            if self.compression != "none":
+                raise ValueError(
+                    "worker_mesh does not compose with compressed gossip: "
+                    "the error-feedback estimate exchange is measured on "
+                    "the unsharded path only — run compression studies "
+                    "with worker_mesh=0"
+                )
+            if self.replicas > 1:
+                raise ValueError(
+                    "worker_mesh and replicas > 1 are mutually exclusive: "
+                    "the replica axis vmaps one unsharded program (the "
+                    "replica axis fills the chip instead of the worker "
+                    "mesh) — run sharded seeds sequentially"
+                )
+            if self.tp_degree > 1:
+                raise ValueError(
+                    "worker_mesh and tp_degree > 1 are mutually "
+                    "exclusive: the TP path pins its own 2-D (workers, "
+                    "model) mesh"
                 )
         if self.execution not in EXECUTIONS:
             raise ValueError(f"Unknown execution mode: {self.execution}")
@@ -944,7 +1085,10 @@ class ExperimentConfig:
                     "vmaps the whole compiled program, but shard_map "
                     "stencils pin a fixed device mesh and the pallas "
                     "kernels address unbatched VMEM blocks — use 'auto', "
-                    "'dense', 'stencil', or 'sparse'"
+                    "'dense', 'stencil', 'sparse', or 'gather' (the "
+                    "sharded-gather worker_mesh path is likewise "
+                    "mesh-pinned and unbatchable; run sharded seeds "
+                    "sequentially)"
                 )
             if self.algorithm == "choco":
                 raise ValueError(
@@ -1066,6 +1210,13 @@ class ExperimentConfig:
         """
         if self.topology_impl != "auto":
             return self.topology_impl
+        if self.worker_mesh >= 2:
+            # The sharded worker mesh is neighbor-table-native: shards
+            # hold [N/P, k_max] table blocks and halo-exchange boundary
+            # rows (docs/PERF.md §16). __post_init__ already rejected
+            # every dense-only feature for worker_mesh >= 2, so 'auto'
+            # resolves to the matrix-free form at ANY N.
+            return "neighbor"
         dense_only_feature = (
             self.backend != "jax"
             or self.topology not in NEIGHBOR_TOPOLOGIES

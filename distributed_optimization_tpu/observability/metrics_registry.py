@@ -104,6 +104,19 @@ class _Family:
         with self._registry._lock:
             self._values[key] = float(value)
 
+    def reset(self) -> None:
+        """Drop every labeled series in this family (gauges only).
+
+        For families whose label universe is run-scoped — e.g. the
+        worker-mesh per-device series — a new run must replace the old
+        set wholesale, or a smaller mesh leaves stale device labels
+        exporting a topology that is no longer running.
+        """
+        if self.kind != "gauge":
+            raise TypeError(f"{self.name} is a {self.kind}, not a gauge")
+        with self._registry._lock:
+            self._values.clear()
+
     def observe(self, value: float, **labels) -> None:
         if self.kind != "histogram":
             raise TypeError(f"{self.name} is a {self.kind}, not a histogram")

@@ -351,6 +351,12 @@ PERF_TOLERANCES: dict[str, tuple[Check, ...]] = {
         Check("floors.accelerator_speedup_at_r32", equal=True),
         Check("floors.cpu_steady_speedup_at_r32", equal=True),
     ),
+    "worker_mesh.json": (
+        Check("gates.*", equal=True, bool_only=True),
+        Check("gates.parity_max_objective_rel_deviation_f64",
+              rtol=1.0, atol_floor=1e-12, direction="max"),
+        Check("gates.n100k_ici_bytes_per_device_per_round", equal=True),
+    ),
 }
 
 

@@ -33,9 +33,18 @@ same linear operator has three interchangeable compiled forms:
 - ``shard_map``: explicit-collective form of the same stencils using
   ``jax.lax.ppermute``/``psum`` (see ``parallel/collectives.py``), for when
   manual control over the collective schedule is wanted.
+- ``gather`` (round 9): the matrix-free k_max-bounded form over padded
+  ``[N, k_max]`` neighbor tables — O(N·k_max·d), no [N, N] object
+  anywhere; the route that lifts the worker axis to N ≥ 10k. Its SHARDED
+  twin is ``parallel/collectives.make_halo_mixing_op`` (impl tag
+  ``'halo_gather'``, the ``worker_mesh`` axis, docs/PERF.md §16): the
+  same per-row op sequence with the worker rows split over a device mesh
+  and boundary rows ppermute-fetched at shard edges — bitwise this
+  operator at matched N, selected by the backend (not here) because it
+  needs the device mesh.
 
-All three agree to floating-point tolerance; property tests check stencil and
-shard_map forms against the dense matrix.
+All forms agree to floating-point tolerance; property tests check stencil
+and shard_map forms against the dense matrix.
 """
 
 from __future__ import annotations

@@ -731,8 +731,16 @@ def make_faulty_mixing(
     keys: Optional[tuple] = None,
     timeline: Optional[FaultTimeline] = None,
     participation_rate: float = 1.0,
+    mesh=None,
 ) -> FaultyMixing:
     """Build time-varying mixing operators for a base topology.
+
+    ``mesh`` (ISSUE-11, docs/PERF.md §16): a 1-D worker ``Mesh`` — the
+    matrix-free node-process route then runs SHARDED: timeline columns
+    are placed per-shard, and the realized-MH gossip round becomes a
+    ppermute halo exchange (``make_halo_faulty_mixing``), bitwise the
+    unsharded gather realization. Dense topologies reject a mesh here
+    (the sharded path is neighbor-table-native).
 
     All internal fault machinery (masks, realized adjacency, MH weights,
     degree accounting) runs in float32; only ``mix``/``neighbor_sum`` outputs
@@ -824,6 +832,12 @@ def make_faulty_mixing(
             mttf=mttf, mttr=mttr,
             participation_rate=participation_rate,
         )
+    if mesh is not None and not topo.is_matrix_free:
+        raise ValueError(
+            "sharded (worker_mesh) fault mixing is neighbor-table-native: "
+            f"dense topology {topo.name!r} has no halo form — build the "
+            "graph with topology_impl='neighbor'"
+        )
     if topo.is_matrix_free:
         # Matrix-free (neighbor-table-native) route: node-process faults
         # (participation sampling, iid stragglers, crash-recovery churn)
@@ -838,6 +852,20 @@ def make_faulty_mixing(
                 "matrix-free topologies support synchronous fault "
                 "processes only; matching schedules and directed graphs "
                 "need the dense adjacency — use topology_impl='dense'"
+            )
+        if mesh is not None:
+            if timeline is not None and timeline.edge_up is not None:
+                raise ValueError(
+                    "sharded (worker_mesh) fault mixing composes node "
+                    "processes only; per-edge chains need per-shard "
+                    "slicing of the [horizon, E] timeline — run edge "
+                    "faults unsharded"
+                )
+            return make_halo_faulty_mixing(
+                topo, mesh, timeline,
+                drop_prob=drop_prob, straggler_prob=straggler_prob,
+                churn_active=churn_active,
+                participation_active=participation_active, rejoin=rejoin,
             )
         return _make_gather_faulty_mixing(
             topo, timeline, drop_prob=drop_prob,
@@ -1230,6 +1258,154 @@ def _make_gather_faulty_mixing(
         churn_active=churn_active,
         rejoin=rejoin,
         rejoin_restart=rejoin_restart,
+        participation_active=participation_active,
+        timeline=timeline,
+    )
+
+
+def make_halo_faulty_mixing(
+    topo: Topology,
+    mesh,
+    timeline: Optional[FaultTimeline],
+    *,
+    drop_prob: float,
+    straggler_prob: float,
+    churn_active: bool,
+    participation_active: bool,
+    rejoin: str,
+) -> FaultyMixing:
+    """Sharded (worker-mesh) twin of ``_make_gather_faulty_mixing``.
+
+    Node-process faults (iid stragglers, crash-recovery churn, client
+    sampling) over a matrix-free topology with the worker axis split into
+    contiguous blocks over ``mesh`` (docs/PERF.md §16). The [horizon, N]
+    timeline masks are device-placed with their NODE axis sharded — each
+    device holds only its own [horizon, N/P] timeline slice — and one
+    realized-MH gossip round runs as TWO halo exchanges inside shard_map:
+    first the per-node availability bit (1 float per boundary row, so
+    each shard can realize its live slots and degrees locally), then the
+    model rows with the realized degree riding as one extra column (the
+    neighbor-degree term of the MH weight). Per-row arithmetic mirrors
+    the unsharded gather form term for term — f32 liveness, accumulation
+    dtype floor, identity-row degeneration — so sharded and unsharded
+    realizations are BITWISE identical (tests/test_worker_mesh.py).
+
+    Not yet sharded (rejected upstream with the missing piece named):
+    per-edge chains (need per-shard [horizon, E] slicing) and the
+    ``neighbor_restart`` rejoin policy (needs the halo-averaged warm
+    restart).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_optimization_tpu.parallel.collectives import (
+        make_halo_exchange,
+    )
+    from distributed_optimization_tpu.parallel.mesh import WORKER_AXIS
+
+    if timeline is not None and timeline.edge_up is not None:
+        raise ValueError(
+            "sharded fault mixing composes node processes only (see "
+            "make_faulty_mixing)"
+        )
+    if churn_active and rejoin == "neighbor_restart":
+        raise ValueError(
+            "rejoin='neighbor_restart' has no sharded form yet (the warm "
+            "restart needs the halo-averaged neighborhood) — use 'frozen'"
+        )
+    n = topo.n
+    hx = make_halo_exchange(topo, mesh)
+    nbr_global = jnp.asarray(topo.nbr_idx, dtype=jnp.int32)
+
+    def _col_sharded(host_arr):
+        # [horizon, N] bool → device array with the NODE axis sharded:
+        # the per-shard timeline slice of the tentpole contract.
+        return jax.device_put(
+            jnp.asarray(host_arr),
+            NamedSharding(mesh, P(None, WORKER_AXIS)),
+        )
+
+    node_up_dev = (
+        _col_sharded(timeline.node_up)
+        if timeline is not None and timeline.node_up is not None else None
+    )
+    part_up_dev = (
+        _col_sharded(timeline.part_up)
+        if timeline is not None and timeline.part_up is not None else None
+    )
+
+    def active(t) -> jax.Array:
+        if node_up_dev is None and part_up_dev is None:
+            return jnp.ones(n, dtype=jnp.float32)
+        if node_up_dev is None:
+            return part_up_dev[t].astype(jnp.float32)
+        m = node_up_dev[t].astype(jnp.float32)
+        if part_up_dev is not None:
+            m = m * part_up_dev[t].astype(jnp.float32)
+        return m
+
+    def _mix_body(exchange, nbr_l, mask_f32, xb, mb):
+        # The unsharded gather form, shard-local: live in f32, weights and
+        # models in the accumulation dtype, neighbor degrees fetched
+        # through the second exchange's extra column.
+        acc = jnp.promote_types(jnp.float32, xb.dtype)
+        m_ext = exchange(mb[:, None])[:, 0]               # [S + h + 1] f32
+        lv = (mask_f32 * mb[:, None] * m_ext[nbr_l]).astype(acc)
+        deg = jnp.sum(lv, axis=1)                          # [S] acc
+        xa = xb.astype(acc)
+        d2 = xa.shape[-1]
+        ext = exchange(jnp.concatenate([xa, deg[:, None]], axis=1))
+        gathered = ext[nbr_l]                              # [S, k, d2 + 1]
+        w = lv / (1.0 + jnp.maximum(deg[:, None], gathered[:, :, d2]))
+        w_self = 1.0 - jnp.sum(w, axis=1)
+        out = w_self[:, None] * xa + jnp.sum(
+            w[:, :, None] * gathered[:, :, :d2], axis=1
+        )
+        return out.astype(xb.dtype)
+
+    def _nbr_body(exchange, nbr_l, mask_f32, xb, mb):
+        acc = jnp.promote_types(jnp.float32, xb.dtype)
+        m_ext = exchange(mb[:, None])[:, 0]
+        lv = (mask_f32 * mb[:, None] * m_ext[nbr_l]).astype(acc)
+        xa = xb.astype(acc)
+        ext = exchange(xa)
+        out = jnp.sum(lv[:, :, None] * ext[nbr_l], axis=1)
+        return out.astype(xb.dtype)
+
+    def mix(t, x):
+        shape = x.shape
+        x2 = x.reshape(shape[0], -1)
+        out = hx.run(_mix_body, x2, active(t))
+        return out.reshape(shape)
+
+    def neighbor_sum(t, x):
+        shape = x.shape
+        x2 = x.reshape(shape[0], -1)
+        out = hx.run(_nbr_body, x2, active(t))
+        return out.reshape(shape)
+
+    def realized_degree_sum(t):
+        # Observability path (floats accounting / trace): the [N] mask
+        # gathered over the global table is a cheap GSPMD gather of N
+        # floats — the model-payload traffic stays on the halo path.
+        m = active(t)
+        lv = (
+            jnp.asarray(topo.nbr_mask, dtype=jnp.float32)
+            * m[:, None] * m[nbr_global]
+        )
+        return jnp.sum(lv)
+
+    return FaultyMixing(
+        mix=mix,
+        neighbor_sum=neighbor_sum,
+        realized_degree_sum=realized_degree_sum,
+        active=active,
+        drop_prob=drop_prob if isinstance(drop_prob, (int, float)) else 0.0,
+        straggler_prob=straggler_prob,
+        realized_adjacency=None,
+        make_neighbor_liveness=None,
+        churn_active=churn_active,
+        rejoin=rejoin,
+        rejoin_restart=None,
         participation_active=participation_active,
         timeline=timeline,
     )

@@ -36,6 +36,27 @@ def make_worker_mesh(
     return Mesh(devices[:k], (WORKER_AXIS,))
 
 
+def make_sized_worker_mesh(n_devices: int) -> Mesh:
+    """1-D worker mesh of EXACTLY ``n_devices`` devices.
+
+    The ``worker_mesh`` config axis (docs/PERF.md §16) pins the shard
+    count as a contract — the halo plan, the per-shard timeline slices
+    and the bytes-over-ICI accounting are all built for that exact P —
+    so unlike ``make_worker_mesh`` there is no best-effort shrink: too
+    few visible devices is an error naming the CPU-host simulation
+    escape hatch.
+    """
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"worker_mesh={n_devices} needs that many devices; only "
+            f"{len(devices)} visible — on CPU hosts set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=P before "
+            "importing jax"
+        )
+    return Mesh(devices[:n_devices], (WORKER_AXIS,))
+
+
 def worker_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Sharding that splits axis 0 (workers) and replicates the rest."""
     return NamedSharding(mesh, P(WORKER_AXIS, *([None] * (ndim - 1))))

@@ -249,6 +249,16 @@ def _health_section(records) -> list[str]:
                     f"{comms['floats_per_gradient_step']:.4g} "
                     f"(τ={comms['local_steps']})"
                 )
+            ici = comms.get("ici")
+            if ici is not None:
+                # Sharded worker mesh (docs/PERF.md §16): REAL collective
+                # traffic next to the analytic floats — the static halo
+                # plan's per-device ppermute bytes per gossip round.
+                parts.append(
+                    f"ICI {ici['bytes_per_device_per_round_max']:,} "
+                    f"B/dev/round over P={ici['worker_mesh']} mesh "
+                    f"(halo {ici['halo_rows_max']} rows)"
+                )
         if parts:
             lines.append(f"  {rec.label:<26}" + ", ".join(parts))
     return lines

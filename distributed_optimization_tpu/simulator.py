@@ -214,14 +214,18 @@ class Simulator:
             spectral_gap=result.history.spectral_gap,
         )
         health = None
-        if cfg.telemetry or cfg.execution == "async":
+        if cfg.telemetry or cfg.execution == "async" or cfg.worker_mesh >= 2:
             # Async runs carry no in-scan trace buffers, but their health
             # block (staleness histogram, virtual-clock skew, floats per
             # virtual second) derives from the presampled event timeline
             # — always available, so always surfaced (docs/ASYNC.md).
+            # Sharded worker-mesh runs likewise: the bytes-over-ICI block
+            # derives from the static halo plan (docs/PERF.md §16).
             from distributed_optimization_tpu.telemetry import health_summary
 
-            health = health_summary(cfg, result.history)
+            health = health_summary(
+                cfg, result.history, d_features=self.dataset.n_features
+            )
         record = ExperimentRecord(
             label, cfg, result, summary, batch=batch, replicate_stats=stats,
             health=health,

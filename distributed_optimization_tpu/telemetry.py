@@ -429,7 +429,10 @@ def realized_bhat(
             "horizon": horizon}
 
 
-def health_summary(config, history, *, serving: Optional[dict] = None) -> dict:
+def health_summary(
+    config, history, *, serving: Optional[dict] = None,
+    d_features: Optional[int] = None,
+) -> dict:
     """Derive the run-health block from a finished run's history.
 
     Always includes the final gap, the realized/nominal connectivity
@@ -482,7 +485,9 @@ def health_summary(config, history, *, serving: Optional[dict] = None) -> dict:
         h["realized_edge_frac"] = (
             float(live.mean() / nominal) if nominal else None
         )
-    h["comms"] = comms_summary(config, history)
+    h["comms"] = comms_summary(
+        config, history, topo=topo, d_features=d_features
+    )
     h["windowed_connectivity"] = realized_bhat(config, topo=topo)
     # Async block scoped to the rounds THIS history executed (a
     # continuation slice's eval axis carries its global round window, so
@@ -568,7 +573,9 @@ def async_summary(config, *, rounds=None) -> Optional[dict]:
     }
 
 
-def comms_summary(config, history) -> Optional[dict]:
+def comms_summary(
+    config, history, *, topo=None, d_features: Optional[int] = None
+) -> Optional[dict]:
     """Bytes-moved accounting block (ISSUE-6 satellite).
 
     Derived from the run's OWN float accounting so it is exact on every
@@ -613,7 +620,144 @@ def comms_summary(config, history) -> Optional[dict]:
             out["floats_per_edge_per_iteration"] = float(
                 per_iter / live.mean()
             )
+    ici = ici_summary(config, topo=topo, d_features=d_features)
+    if ici is not None:
+        # Sharded worker mesh (docs/PERF.md §16): real collective bytes
+        # alongside the analytic floats — the halo plan is static, so the
+        # per-device ppermute traffic is exact, and simulated floats and
+        # ICI bytes finally sit in one report (the PAPER.md north star).
+        out["ici"] = ici
     return out
+
+
+def ici_summary(
+    config, *, topo=None, d_features: Optional[int] = None
+) -> Optional[dict]:
+    """Bytes-over-ICI block for sharded worker-mesh runs (ISSUE-11).
+
+    Rebuilds the static halo-exchange plan host-side — the identical plan
+    the backend's shard_map mixing executes — and prices the per-device
+    ppermute traffic exactly: each device ships the rotation-padded WIRE
+    rows per gossip round (every rotation pads to its max per-device
+    count so the collective is shape-uniform; on regular rings wire ==
+    useful, on irregular graphs the pad rows ride the wire too), each
+    row carrying the per-config payload width. Plain gossip moves the
+    d_model model row in the state dtype; node-process faults
+    (stragglers/churn/participation) add the 1-float availability
+    exchange (always f32 on the wire) plus the realized-degree column
+    riding the model buffer in the body's accumulation dtype
+    (``faults.make_halo_faulty_mixing``); robust screening adds the
+    availability exchange, and clipped gossip additionally the degree
+    column (``collectives.make_halo_robust_aggregator_t``). An active
+    adversary executes BOTH branches of the screened mix's ``jnp.where``
+    (the benign base mix AND the honest view —
+    ``parallel/adversary.py``), so attack configs price two exchange
+    forms per round. None when
+    the run is unsharded (``worker_mesh`` off) or centralized. The same
+    numbers feed the PR-10 metrics registry as ``dopt_worker_mesh_*``
+    per-device gauges when the backend actually runs.
+
+    ``topo``: the already-built topology when the caller has one
+    (``health_summary`` builds it once for every block) — rebuilding a
+    matrix-free Erdős–Rényi graph replays the dense sampler's O(N²)
+    stream, so the one-build convention matters here.
+    """
+    if getattr(config, "worker_mesh", 0) < 2:
+        return None
+    from distributed_optimization_tpu.algorithms import get_algorithm
+    from distributed_optimization_tpu.models import get_problem
+    from distributed_optimization_tpu.parallel.topology import (
+        build_halo_plan,
+        neighbor_tables_for,
+    )
+
+    algo = get_algorithm(config.algorithm)
+    if not algo.is_decentralized:
+        return None
+    if topo is None:
+        topo = _config_topology(config)
+    nbr_idx, nbr_mask = neighbor_tables_for(topo)
+    plan = build_halo_plan(nbr_idx, nbr_mask, config.worker_mesh)
+    problem = get_problem(
+        config.problem_type, huber_delta=config.huber_delta,
+        n_classes=config.n_classes,
+    )
+    # The trained dimension — the payload width every gossip round
+    # actually moves per row — plus the fault/robust side-channel floats
+    # enumerated in the docstring. ``d_features`` is the DATASET's
+    # realized column count (bias included) when the caller has one
+    # (Simulator/backend do; the digits dataset ignores ``n_features``);
+    # the config-derived ``n_features + 1`` is the synthetic-path value.
+    if d_features is None:
+        d_features = config.n_features + 1
+    d_model = problem.param_dim(d_features)
+    robust = config.aggregation != "gossip" and config.robust_b > 0
+    attack = config.attack != "none"
+    node_faults = (
+        config.straggler_prob > 0.0
+        or config.mttf > 0.0
+        or config.participation_rate < 1.0
+    )
+    if robust:
+        avail = 1
+        deg_col = 1 if config.aggregation == "clipped_gossip" else 0
+    elif node_faults:
+        avail, deg_col = 1, 1  # availability bit + realized-degree column
+    else:
+        avail = deg_col = 0
+    floats_per_row = (d_model + deg_col + avail) * algo.gossip_rounds
+    itemsize = int(np.dtype(config.dtype).itemsize)
+    # Per-row bytes of each exchange FORM the compiled round can run.
+    # The availability bit ships as its OWN f32 halo exchange (fault
+    # masks are explicit float32 on every path — 4 B/row at any model
+    # dtype); the fault/robust model buffers ship in the bodies'
+    # ACCUMULATION dtype (promote(f32, model) — 4 B floats even under
+    # bfloat16 state); only the plain no-fault mixing op exchanges in
+    # the state dtype itself.
+    acc_size = max(itemsize, 4)
+    if node_faults:
+        base_row = 4 + (d_model + 1) * acc_size  # avail + model+degree
+    else:
+        base_row = d_model * itemsize            # plain halo mix
+    robust_row = 4 + (d_model + deg_col) * acc_size
+    # An active adversary executes BOTH branches of the screened mix's
+    # jnp.where (parallel/adversary.py::make_byzantine_mixing): the
+    # benign base mix for Byzantine rows AND the honest view — the
+    # robust aggregate when a rule defends, the base mix of the
+    # corrupted stack otherwise. A pure defense (robust rule, no
+    # attack) binds the aggregate alone.
+    if attack and robust:
+        round_row_bytes = base_row + robust_row
+    elif attack:
+        round_row_bytes = 2 * base_row
+    elif robust:
+        round_row_bytes = robust_row
+    else:
+        round_row_bytes = base_row
+    row_bytes = algo.gossip_rounds * round_row_bytes
+    # Wire rows, not useful rows: every rotation pads to its max
+    # per-device count so the ppermute stays shape-uniform — each device
+    # ships s_max rows per rotation whether or not all of them are
+    # referenced by the destination (HaloStep.send_idx pad rows).
+    wire_rows = int(sum(st.send_idx.shape[1] for st in plan.steps))
+    sent = plan.sent_rows.astype(np.int64)
+    n_dev = int(config.worker_mesh)
+    return {
+        "worker_mesh": n_dev,
+        "shard_rows": int(plan.shard_rows),
+        "halo_rows_max": int(plan.h_max),
+        "halo_rows_per_device": [
+            int(len(h)) for h in plan.halo_idx
+        ],
+        "exchange_rotations": len(plan.steps),
+        "wire_rows_per_device": wire_rows,
+        "useful_rows_per_device": [int(r) for r in sent],
+        "bytes_per_device_per_round": [wire_rows * row_bytes] * n_dev,
+        "bytes_per_device_per_round_max": wire_rows * row_bytes,
+        "bytes_total_per_round": n_dev * wire_rows * row_bytes,
+        "payload_floats_per_row": int(floats_per_row),
+        "itemsize": itemsize,
+    }
 
 
 def _nominal_degree_sum(config) -> Optional[float]:

@@ -396,6 +396,9 @@ PERF_ARTIFACT_KEYS = {
         "device", "platform", "protocol", "note", "cells", "gates"},
     "trace_summary.json": {
         "device_total_us", "note", "source", "top_device_ops"},
+    "worker_mesh.json": {
+        "device", "platform", "protocol", "note", "parity", "scale",
+        "gates"},
 }
 
 
