@@ -3,11 +3,11 @@
 
 PY ?= python
 
-.PHONY: test smoke serve-smoke observatory-smoke perf-diff \
+.PHONY: test smoke serve-smoke observatory-smoke scenarios-smoke perf-diff \
 	bench-byzantine bench-churn \
 	bench-robust-scale bench-sweep bench-compute bench-telemetry \
 	bench-fused bench-serving bench-federated bench-async \
-	bench-observatory bench-mesh
+	bench-observatory bench-mesh bench-scenarios
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -28,8 +28,18 @@ smoke:
 		tests/test_telemetry.py tests/test_serving.py \
 		tests/test_federated.py tests/test_async.py \
 		tests/test_matrix_free_faults.py tests/test_observatory.py \
-		tests/test_worker_mesh.py
+		tests/test_worker_mesh.py tests/test_scenarios.py \
+		tests/test_scenario_chaos.py
 	$(MAKE) observatory-smoke
+	$(MAKE) scenarios-smoke
+
+# End-to-end scenario-engine smoke (docs/SCENARIOS.md): a seeded sample
+# over a mixed axis bank (validity agreement + per-cell invariants +
+# warm-replay identity through the real serving layer), then one
+# operational chaos kill/restart cycle served warm from the surviving
+# executable cache.
+scenarios-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/scenarios_smoke.py
 
 # End-to-end live-observatory smoke over real HTTP (docs/OBSERVABILITY.md):
 # boot the daemon, stream /v1/progress while a run executes, scrape
@@ -123,6 +133,14 @@ bench-serving:
 # bitwise gate, async-path cell, /metrics scrape p95 under load).
 bench-observatory:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_observatory.py
+
+# Regenerate the scenario-matrix golden corpus (docs/perf/scenarios.json:
+# validity-table agreement over a seeded 700-cell sample, the
+# 34-composition golden matrix with per-cell invariants + warm replay,
+# bitwise checkpoint-resume cells, and the operational chaos gates;
+# forces 4 host devices itself for the worker-mesh cells).
+bench-scenarios:
+	$(PY) examples/bench_scenarios.py
 
 # Regenerate the sharded worker-mesh evidence (docs/perf/worker_mesh.json:
 # sharded-vs-unsharded bitwise parity, the N=100k completion over 4

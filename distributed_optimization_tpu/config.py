@@ -1387,5 +1387,23 @@ class ExperimentConfig:
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in known})
 
+    @classmethod
+    def construction_error(cls, fields: dict[str, Any]) -> "str | None":
+        """The validation message constructing these fields would raise, or
+        None when they build a valid config.
+
+        The scenario engine's ground truth (docs/SCENARIOS.md): the
+        declarative validity table in ``scenarios/validity.py`` mirrors
+        ``__post_init__``'s composition rules for structured querying, and
+        its agreement with THIS function — verdict for verdict over every
+        sampled cell of the composition matrix — is what keeps the two
+        from silently drifting apart.
+        """
+        try:
+            cls(**fields)
+        except (TypeError, ValueError) as e:
+            return str(e)
+        return None
+
     def replace(self, **kwargs: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kwargs)

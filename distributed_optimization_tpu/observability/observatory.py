@@ -351,6 +351,16 @@ PERF_TOLERANCES: dict[str, tuple[Check, ...]] = {
         Check("floors.accelerator_speedup_at_r32", equal=True),
         Check("floors.cpu_steady_speedup_at_r32", equal=True),
     ),
+    "scenarios.json": (
+        # The golden corpus (ISSUE-12): every gate boolean — validity
+        # agreement, per-cell invariants, warm replay, chaos
+        # degradation — plus the exact cell counts must reproduce.
+        Check("gates.*", equal=True, bool_only=True),
+        Check("gates.agreement_cells", equal=True),
+        Check("gates.matrix_n_valid_cells", equal=True),
+        Check("matrix.counts.valid", equal=True),
+        Check("matrix.invariants.failures", equal=True),
+    ),
     "worker_mesh.json": (
         Check("gates.*", equal=True, bool_only=True),
         Check("gates.parity_max_objective_rel_deviation_f64",

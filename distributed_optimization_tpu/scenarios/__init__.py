@@ -1,0 +1,26 @@
+"""Scenario engine + chaos harness (ISSUE-12, docs/SCENARIOS.md).
+
+The composition matrix as a first-class tested surface: a queryable
+validity table over the repo's ~10 orthogonal axes (``validity``),
+declarative scenario specs (``spec``), seeded enumeration/property
+sampling (``generator``), a serving-driven runner asserting per-cell
+invariants (``engine``/``invariants``), and operational fault injection
+against the serving plane itself (``chaos``).
+
+``python -m distributed_optimization_tpu.scenarios`` is the CLI.
+"""
+
+from distributed_optimization_tpu.scenarios.engine import (  # noqa: F401
+    ScenarioEngine,
+    run_scenarios,
+)
+from distributed_optimization_tpu.scenarios.generator import (  # noqa: F401
+    generate,
+)
+from distributed_optimization_tpu.scenarios.spec import (  # noqa: F401
+    ScenarioSpec,
+    SpecError,
+    load_spec,
+    parse_spec,
+)
+from distributed_optimization_tpu.scenarios import validity  # noqa: F401

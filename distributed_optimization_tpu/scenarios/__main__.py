@@ -1,0 +1,185 @@
+"""``python -m distributed_optimization_tpu.scenarios`` — the scenario CLI.
+
+Subcommands:
+
+- ``explain field=value ...``  query the validity table for one cell:
+  prints "valid" or the rejecting rule + exact reason (exit 0 either
+  way; exit 2 on unknown fields, with the nearest valid field named).
+- ``sample SPEC [--json]``     generate the spec's seeded cell set and
+  print the validity accounting WITHOUT running anything.
+- ``run SPEC [--out OUT]``     run the engine: serve every valid cell,
+  assert per-cell invariants, write the JSON report. Exit 1 when any
+  invariant fails or a cell errors; 0 on a clean matrix.
+- ``chaos [--out OUT]``        run the operational chaos suite against a
+  fresh serving plane; exit 1 on any non-graceful degradation.
+
+Error contract: malformed specs and bad field names print ONE structured
+``scenarios: error: ...`` line (offending field + nearest-valid-field
+suggestion) on stderr and exit 2 — never a traceback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from distributed_optimization_tpu.scenarios.spec import SpecError
+from distributed_optimization_tpu.scenarios.validity import (
+    UnknownFieldError,
+)
+
+
+def _coerce(value: str):
+    """CLI field=value parsing: JSON literal when it parses, else str."""
+    try:
+        return json.loads(value)
+    except json.JSONDecodeError:
+        return value
+
+
+def _cmd_explain(args) -> int:
+    from distributed_optimization_tpu.scenarios import validity
+
+    overrides = {}
+    for pair in args.fields:
+        if "=" not in pair:
+            print(
+                f"scenarios: error: expected field=value, got {pair!r}",
+                file=sys.stderr,
+            )
+            return 2
+        key, _, value = pair.partition("=")
+        overrides[key] = _coerce(value)
+    verdict = validity.explain(validity.full_fields(overrides))
+    if args.json:
+        print(json.dumps({
+            "valid": verdict.valid, "rule": verdict.rule,
+            "axes": list(verdict.axes), "reason": verdict.reason,
+        }, indent=1))
+    elif verdict.valid:
+        print("valid")
+    else:
+        print(f"invalid [{verdict.rule}] ({'×'.join(verdict.axes)})")
+        print(f"  {verdict.reason}")
+    return 0
+
+
+def _cmd_sample(args) -> int:
+    from distributed_optimization_tpu.scenarios.generator import generate
+    from distributed_optimization_tpu.scenarios.spec import load_spec
+
+    sample = generate(load_spec(args.spec))
+    counts = sample.counts()
+    if args.json:
+        print(json.dumps({
+            "spec": sample.spec.name, "seed": sample.spec.seed,
+            "counts": counts,
+            "cells": [c.row() for c in sample.cells],
+        }, indent=1))
+        return 0
+    print(
+        f"spec {sample.spec.name!r} (seed {sample.spec.seed}, "
+        f"{sample.spec.mode}): {counts['cells']} cells — "
+        f"{counts['valid']} valid, {counts['rejected']} rejected"
+    )
+    for rule, n in counts["rejected_by_rule"].items():
+        print(f"  {n:5d}  {rule}")
+    return 0
+
+
+def _cmd_run(args) -> int:
+    from distributed_optimization_tpu.scenarios.engine import run_scenarios
+    from distributed_optimization_tpu.scenarios.spec import load_spec
+
+    report = run_scenarios(load_spec(args.spec))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"[scenarios] report -> {args.out}", file=sys.stderr)
+    gates = report["gates"]
+    inv = report["invariants"]
+    print(
+        f"[scenarios] {report['counts']['valid']} valid cells, "
+        f"{inv['checks']} invariant checks, {inv['failures']} failures "
+        f"({report['wall_seconds']:.1f}s)"
+    )
+    for name, ok in sorted(gates.items()):
+        print(f"  {'PASS' if ok else 'FAIL'}  {name}")
+    return 0 if all(gates.values()) else 1
+
+
+def _cmd_chaos(args) -> int:
+    from distributed_optimization_tpu.scenarios.chaos import run_chaos_suite
+
+    suite = run_chaos_suite()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(suite, f, indent=1, sort_keys=True)
+        print(f"[scenarios] chaos report -> {args.out}", file=sys.stderr)
+    for record in suite["records"]:
+        print(
+            f"  {'PASS' if record['passed'] else 'FAIL'}  {record['mode']}"
+        )
+    return 0 if all(suite["gates"].values()) else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="distributed_optimization_tpu.scenarios",
+        description=(
+            "Scenario engine + chaos harness over the composition matrix "
+            "(docs/SCENARIOS.md)."
+        ),
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pe = sub.add_parser(
+        "explain",
+        help="classify one cell: valid, or the rejecting rule + reason",
+    )
+    pe.add_argument("fields", nargs="*",
+                    help="config overrides as field=value (JSON literals)")
+    pe.add_argument("--json", action="store_true")
+    pe.set_defaults(fn=_cmd_explain)
+
+    ps = sub.add_parser(
+        "sample", help="generate a spec's cell set without running it",
+    )
+    ps.add_argument("spec", help="scenario spec file (JSON; YAML when "
+                                 "available)")
+    ps.add_argument("--json", action="store_true")
+    ps.set_defaults(fn=_cmd_sample)
+
+    pr = sub.add_parser(
+        "run", help="run a spec through the serving layer + invariants",
+    )
+    pr.add_argument("spec")
+    pr.add_argument("--out", default=None, help="write the JSON report here")
+    pr.set_defaults(fn=_cmd_run)
+
+    pc = sub.add_parser(
+        "chaos", help="run the operational chaos suite",
+    )
+    pc.add_argument("--out", default=None)
+    pc.set_defaults(fn=_cmd_chaos)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (SpecError, UnknownFieldError) as e:
+        hint = getattr(e, "suggestion", None)
+        extra = "" if hint is None else f" (did you mean {hint!r}?)"
+        # The suggestion is already part of str(e) for these types; the
+        # extra clause only fires for bare UnknownFieldError paths.
+        msg = str(e)
+        print(
+            f"scenarios: error: {msg}"
+            + (extra if hint and hint not in msg else ""),
+            file=sys.stderr,
+        )
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,418 @@
+"""Chaos harness: operational fault injection against the serving plane.
+
+The scenario matrix (engine.py) proves the SIMULATED failure axes —
+dropped edges, churn, Byzantine payloads — compose correctly; this module
+injects OPERATIONAL failures into the serving machinery itself and
+asserts graceful degradation (ISSUE-12 part c). Modes:
+
+- ``poisoned_cohort``: a request that passes config validation but is
+  rejected by the backend (robust budget > the topology's min degree)
+  rides the same scheduling cut as a healthy cohort. The poison must fail
+  ALONE with a structured error naming the violation (never a traceback),
+  the healthy cohort must complete, and the service must keep serving.
+- ``daemon_kill_restart``: a daemon is stopped abruptly between submit
+  and result (the queued request dies with it). A new daemon over the
+  SAME executable cache must serve the re-submitted request WARM (zero
+  compile seconds — the cache recovery the serving docs promise), and the
+  retrying client must ride out the restart's connection failures.
+- ``truncated_checkpoint``: the latest checkpoint chunk of an interrupted
+  run is gutted mid-save-style; resume must warn, fall back to the last
+  intact chunk, and still end BITWISE where the uninterrupted
+  (equally-segmented) run ends.
+- ``broken_progress_callback``: a progress callback that raises must be
+  contained — the run completes and its trajectory is bitwise the
+  callback-free program.
+
+Each injection increments the ``dopt_scenario_chaos_injections`` gauge
+(per-run reset, ``mode`` label). ``run_chaos_suite`` executes all modes
+and returns a JSON-safe record set with boolean gates — the block the
+golden corpus commits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import time
+import warnings
+from typing import Any, Optional
+
+import numpy as np
+
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.log import get_logger
+from distributed_optimization_tpu.observability.metrics_registry import (
+    metrics_registry,
+)
+
+_log = get_logger("scenarios.chaos")
+
+CHAOS_MODES = (
+    "poisoned_cohort", "daemon_kill_restart", "truncated_checkpoint",
+    "broken_progress_callback",
+)
+
+
+@dataclasses.dataclass
+class ChaosRecord:
+    mode: str
+    passed: bool
+    detail: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"mode": self.mode, "passed": self.passed,
+                "detail": self.detail}
+
+
+def _chaos_gauge():
+    return metrics_registry().gauge(
+        "dopt_scenario_chaos_injections",
+        "Operational faults injected by the last chaos-harness run "
+        "(by 'mode' label)",
+    )
+
+
+def default_chaos_config(**overrides) -> ExperimentConfig:
+    """The harness's small canonical workload (compiles in ~a second on
+    the CI container; big enough for multi-chunk checkpointing)."""
+    fields: dict[str, Any] = dict(
+        n_workers=8, n_samples=400, n_features=10,
+        n_informative_features=6, problem_type="quadratic",
+        n_iterations=80, eval_every=10, local_batch_size=8,
+    )
+    fields.update(overrides)
+    return ExperimentConfig(**fields)
+
+
+def _structured_error_ok(message: Optional[str], must_name: str) -> bool:
+    """A graceful failure names its cause and is one message, not a
+    stack dump."""
+    return (
+        message is not None
+        and must_name in message
+        and "Traceback" not in message
+    )
+
+
+# ----------------------------------------------------------------- modes
+
+
+def chaos_poisoned_cohort(*, service=None) -> ChaosRecord:
+    """Poison inside a healthy scheduling cut (see module docstring)."""
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    own = service is None
+    if own:
+        service = SimulationService(
+            ServingOptions(window_s=0.0), cache=ExecutableCache(),
+        )
+    base = default_chaos_config(dtype="float64")
+    healthy = [
+        service.submit(base.replace(learning_rate_eta0=eta))
+        for eta in (0.05, 0.08)
+    ]
+    # Passes config validation; the backend rejects 2·b=6 > ring min
+    # degree 2 — the poison of tests/test_serving.py, now riding a cut
+    # with real traffic.
+    poison = service.submit(base.replace(
+        attack="sign_flip", n_byzantine=1, aggregation="trimmed_mean",
+        robust_b=3, partition="shuffled",
+    ))
+    service.drain()
+    detail: dict[str, Any] = {}
+    preq = service.result(poison, timeout=60.0)
+    detail["poison_status"] = preq.status
+    detail["poison_error_structured"] = _structured_error_ok(
+        preq.error, "robust_b"
+    )
+    healthy_reqs = [service.result(rid, timeout=60.0) for rid in healthy]
+    detail["healthy_statuses"] = [r.status for r in healthy_reqs]
+    detail["healthy_cohort_sizes"] = [r.cohort_size for r in healthy_reqs]
+    # Still serving after the poison.
+    follow_up = service.submit(base)
+    service.drain()
+    detail["post_poison_status"] = service.result(
+        follow_up, timeout=60.0
+    ).status
+    passed = (
+        preq.status == "failed"
+        and detail["poison_error_structured"]
+        and all(s == "done" for s in detail["healthy_statuses"])
+        and all(c == 2 for c in detail["healthy_cohort_sizes"])
+        and detail["post_poison_status"] == "done"
+    )
+    _chaos_gauge().set(1, mode="poisoned_cohort")
+    if own:
+        service.close()
+    return ChaosRecord("poisoned_cohort", passed, detail)
+
+
+def chaos_daemon_kill_restart(
+    *, config: Optional[ExperimentConfig] = None,
+) -> ChaosRecord:
+    """Kill a daemon between submit and result; a restarted daemon over
+    the same executable cache serves the re-submission warm."""
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.client import RetryingClient
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    cfg = config or default_chaos_config()
+    cache = ExecutableCache()  # survives the daemon, like a process cache
+    detail: dict[str, Any] = {}
+
+    # --- daemon A: warm the cache with one served run ------------------
+    daemon_a = ServingDaemon(
+        "127.0.0.1", 0,
+        service=SimulationService(
+            ServingOptions(window_s=0.02), cache=cache,
+        ),
+    )
+    daemon_a.start()
+    client = RetryingClient(daemon_a.url, max_retries=8, backoff_s=0.05,
+                            seed=0)
+    code, first = client.run(cfg.to_dict(), timeout=300.0)
+    detail["first_run_status"] = code
+    detail["first_compile_seconds"] = (
+        first.get("compile_seconds") if isinstance(first, dict) else None
+    )
+    # --- kill between submit and result --------------------------------
+    code, sub = client.submit(cfg.replace(seed=push_seed(cfg.seed)).to_dict())
+    killed_id = sub.get("id") if isinstance(sub, dict) else None
+    detail["killed_request_submitted"] = code == 202
+    daemon_a.stop()  # abrupt: the queued request dies with the daemon
+    port = daemon_a.address[1]
+    detail["daemon_a_port"] = port
+
+    # --- daemon B: same cache, same port (the restart) ------------------
+    daemon_b = None
+    try:
+        for _ in range(20):
+            try:
+                daemon_b = ServingDaemon(
+                    "127.0.0.1", port,
+                    service=SimulationService(
+                        ServingOptions(window_s=0.02), cache=cache,
+                    ),
+                )
+                break
+            except OSError:
+                time.sleep(0.1)  # TIME_WAIT on the freed port
+        if daemon_b is None:
+            return ChaosRecord(
+                "daemon_kill_restart", False,
+                {**detail, "error": "could not rebind the daemon port"},
+            )
+        daemon_b.start()
+        # The retrying client rides out any remaining restart window.
+        code, lost = client.result(killed_id, timeout=1.0)
+        detail["killed_request_after_restart"] = {
+            "status": code,
+            "structured": isinstance(lost, dict) and "error" in lost,
+        }
+        code, again = client.run(cfg.replace(
+            seed=push_seed(cfg.seed)
+        ).to_dict(), timeout=300.0)
+        detail["resubmit_status"] = code
+        resubmit_serving = (
+            (again.get("health") or {}).get("serving")
+            if isinstance(again, dict) else None
+        ) or {}
+        detail["resubmit_cache_hit"] = resubmit_serving.get("cache_hit")
+        detail["resubmit_compile_seconds"] = (
+            again.get("compile_seconds") if isinstance(again, dict) else None
+        )
+        detail["client_retries"] = client.n_retries
+        passed = (
+            detail["first_run_status"] == 200
+            and detail["killed_request_submitted"]
+            # The killed id is an honest 404 on the new daemon, not a hang.
+            and detail["killed_request_after_restart"]["status"] == 404
+            and detail["killed_request_after_restart"]["structured"]
+            # The re-submission is served WARM from the surviving cache.
+            and detail["resubmit_status"] == 200
+            and detail["resubmit_cache_hit"] is True
+            and detail["resubmit_compile_seconds"] == 0.0
+        )
+    finally:
+        if daemon_b is not None:
+            daemon_b.stop()
+    _chaos_gauge().set(1, mode="daemon_kill_restart")
+    return ChaosRecord("daemon_kill_restart", passed, detail)
+
+
+def push_seed(seed: int) -> int:
+    """The kill/restart mode's 'different request, same program' seed."""
+    return seed + 101
+
+
+def chaos_truncated_checkpoint(
+    *, config: Optional[ExperimentConfig] = None,
+    workdir: Optional[str] = None,
+) -> ChaosRecord:
+    """Gut the latest checkpoint chunk; resume must fall back to the last
+    intact chunk with a warning and end bitwise with the uninterrupted
+    equally-segmented run."""
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.utils.checkpoint import (
+        CheckpointOptions,
+        RunCheckpointer,
+    )
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+    from distributed_optimization_tpu.utils.oracle import (
+        compute_reference_optimum,
+    )
+
+    cfg = config or default_chaos_config()
+    own_dir = workdir is None
+    base = workdir or tempfile.mkdtemp(prefix="dopt-chaos-ck-")
+    detail: dict[str, Any] = {}
+    try:
+        ds = generate_synthetic_dataset(cfg)
+        _, f_opt = compute_reference_optimum(
+            ds, cfg.reg_param, huber_delta=cfg.huber_delta,
+            n_classes=cfg.n_classes,
+        )
+        every = 2
+        ref = jax_backend.run(cfg, ds, f_opt, checkpoint=CheckpointOptions(
+            os.path.join(base, "ref"), every_evals=every, resume=False,
+        ))
+        ckdir = os.path.join(base, "crash")
+        jax_backend.run(cfg, ds, f_opt, checkpoint=CheckpointOptions(
+            ckdir, every_evals=every, resume=False, max_to_keep=10,
+        ))
+        ck = RunCheckpointer(CheckpointOptions(ckdir, every_evals=every))
+        latest = ck.latest_chunk()
+        detail["latest_chunk"] = latest
+        # Crash-mid-save: the chunk dir survives, the payload does not.
+        step_dir = ck._step_dir(latest)
+        for name in os.listdir(step_dir):
+            p = os.path.join(step_dir, name)
+            shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+        with open(os.path.join(step_dir, "garbage"), "w") as f:
+            f.write("crashed mid-save")
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            resumed = jax_backend.run(
+                cfg, ds, f_opt,
+                checkpoint=CheckpointOptions(
+                    ckdir, every_evals=every, max_to_keep=10,
+                ),
+            )
+        fallback_warned = any(
+            "partial or corrupt" in str(w.message) for w in caught
+        )
+        detail["fallback_warned"] = fallback_warned
+        obj_bitwise = bool(np.array_equal(
+            resumed.history.objective, ref.history.objective
+        ))
+        models_bitwise = bool(np.array_equal(
+            resumed.final_models, ref.final_models
+        ))
+        detail["objective_bitwise"] = obj_bitwise
+        detail["final_models_bitwise"] = models_bitwise
+        passed = fallback_warned and obj_bitwise and models_bitwise
+    finally:
+        if own_dir:
+            shutil.rmtree(base, ignore_errors=True)
+    _chaos_gauge().set(1, mode="truncated_checkpoint")
+    return ChaosRecord("truncated_checkpoint", passed, detail)
+
+
+def chaos_broken_progress_callback(
+    *, config: Optional[ExperimentConfig] = None,
+) -> ChaosRecord:
+    """A raising progress callback must be contained: the run completes
+    and is bitwise the callback-free program."""
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+    from distributed_optimization_tpu.utils.oracle import (
+        compute_reference_optimum,
+    )
+
+    cfg = config or default_chaos_config()
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(
+        ds, cfg.reg_param, huber_delta=cfg.huber_delta,
+        n_classes=cfg.n_classes,
+    )
+    cache = ExecutableCache()
+    calls = {"n": 0}
+
+    def exploding_cb(event):
+        calls["n"] += 1
+        raise RuntimeError("chaos: progress subscriber exploded")
+
+    quiet = jax_backend.run(cfg, ds, f_opt, executable_cache=cache)
+    noisy = jax_backend.run(
+        cfg, ds, f_opt, executable_cache=cache,
+        progress_cb=exploding_cb, progress_every=2,
+    )
+    detail = {
+        "callback_invocations": calls["n"],
+        "objective_bitwise": bool(np.array_equal(
+            noisy.history.objective, quiet.history.objective
+        )),
+        "final_models_bitwise": bool(np.array_equal(
+            noisy.final_models, quiet.final_models
+        )),
+    }
+    passed = (
+        calls["n"] > 0
+        and detail["objective_bitwise"]
+        and detail["final_models_bitwise"]
+    )
+    _chaos_gauge().set(1, mode="broken_progress_callback")
+    return ChaosRecord("broken_progress_callback", passed, detail)
+
+
+# ----------------------------------------------------------------- suite
+
+
+def run_chaos_suite(
+    *, config: Optional[ExperimentConfig] = None,
+    modes: tuple[str, ...] = CHAOS_MODES,
+) -> dict[str, Any]:
+    """Run the chaos modes; returns ``{"records": [...], "gates": {...}}``
+    (JSON-safe — the golden corpus's ``chaos`` block)."""
+    _chaos_gauge().reset()
+    runners = {
+        "poisoned_cohort": lambda: chaos_poisoned_cohort(),
+        "daemon_kill_restart": lambda: chaos_daemon_kill_restart(
+            config=config
+        ),
+        "truncated_checkpoint": lambda: chaos_truncated_checkpoint(
+            config=config
+        ),
+        "broken_progress_callback": lambda: chaos_broken_progress_callback(
+            config=config
+        ),
+    }
+    records = []
+    for mode in modes:
+        if mode not in runners:
+            raise ValueError(
+                f"unknown chaos mode {mode!r} (valid: {CHAOS_MODES})"
+            )
+        _log.info("chaos: injecting %s", mode)
+        records.append(runners[mode]())
+    return {
+        "records": [r.to_dict() for r in records],
+        "gates": {
+            f"{r.mode}_graceful": bool(r.passed) for r in records
+        },
+    }

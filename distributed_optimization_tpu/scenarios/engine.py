@@ -1,0 +1,409 @@
+"""The scenario engine: sampled matrix cells → served runs → invariants.
+
+``ScenarioEngine`` ties the pieces together (docs/SCENARIOS.md):
+
+1. ``generator.generate`` draws the spec's seeded cell set and classifies
+   every cell against the validity table (construction agreement enforced
+   per cell — a divergence aborts the run loudly).
+2. Every valid cell becomes serving traffic: cells are submitted to a
+   ``SimulationService`` in one wave and drained together, so
+   structurally identical cells coalesce into ``run_batch`` cohorts and
+   repeated programs ride the executable cache exactly as production
+   requests would — the engine IS a traffic generator. Cells with
+   ``replicas == R > 1`` are expanded into R seed-variant requests with
+   the dataset and the random-topology seed pinned, which is the serving
+   layer's own replica axis (the coalescer must reassemble the cohort —
+   asserted by the ``replica_cohort`` invariant).
+3. Each completed cell runs its applicable invariant catalog
+   (``scenarios.invariants``); twin runs route through the same service
+   (memoized — a twin shared by two cells runs once).
+
+Per-run metrics (ISSUE-12 satellite): the engine resets and sets the
+``dopt_scenario_*`` gauge families in the process metrics registry —
+cells sampled/valid/rejected (plus a per-rule breakdown), invariant
+checks/failures — the same reset-per-run discipline as the worker-mesh
+per-device gauges.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from distributed_optimization_tpu.config import (
+    RANDOM_TOPOLOGIES,
+    ExperimentConfig,
+)
+from distributed_optimization_tpu.log import get_logger
+from distributed_optimization_tpu.observability.metrics_registry import (
+    metrics_registry,
+)
+from distributed_optimization_tpu.scenarios.generator import (
+    Cell,
+    MatrixSample,
+    generate,
+)
+from distributed_optimization_tpu.scenarios.invariants import (
+    CellContext,
+    applicable_invariants,
+)
+from distributed_optimization_tpu.scenarios.spec import ScenarioSpec
+
+_log = get_logger("scenarios")
+
+
+class EngineRunError(RuntimeError):
+    """A served run the engine depended on failed; carries the service's
+    structured error message."""
+
+
+def _reset_scenario_gauges(reg) -> dict:
+    gauges = {
+        "sampled": reg.gauge(
+            "dopt_scenario_cells_sampled",
+            "Cells drawn from the composition matrix in the last "
+            "scenario-engine run",
+        ),
+        "valid": reg.gauge(
+            "dopt_scenario_cells_valid",
+            "Valid cells in the last scenario-engine run",
+        ),
+        "rejected": reg.gauge(
+            "dopt_scenario_cells_rejected",
+            "Cells the validity table rejected in the last "
+            "scenario-engine run (by rule via the 'rule' label)",
+        ),
+        "checks": reg.gauge(
+            "dopt_scenario_invariant_checks",
+            "Invariant checks executed in the last scenario-engine run",
+        ),
+        "failures": reg.gauge(
+            "dopt_scenario_invariant_failures",
+            "Invariant checks that failed in the last scenario-engine run",
+        ),
+    }
+    for g in gauges.values():
+        g.reset()
+    return gauges
+
+
+class ScenarioEngine:
+    """One spec, one engine run (see the module docstring)."""
+
+    def __init__(
+        self,
+        spec: ScenarioSpec,
+        *,
+        service=None,
+        max_cohort: int = 32,
+        workdir: Optional[str] = None,
+    ):
+        self.spec = spec
+        if service is None:
+            from distributed_optimization_tpu.serving.cache import (
+                ExecutableCache,
+            )
+            from distributed_optimization_tpu.serving.service import (
+                ServingOptions,
+                SimulationService,
+            )
+
+            expected_cells = (
+                spec.sample if spec.mode == "sample"
+                else min(spec.n_cells_total(), spec.max_cells)
+            )
+            service = SimulationService(
+                ServingOptions(
+                    window_s=0.0, max_cohort=max_cohort,
+                    # Every cell and twin must stay pollable for the whole
+                    # engine run; size the history to the spec.
+                    max_done=max(4096, 8 * expected_cells),
+                ),
+                # Size the LRU to the matrix: cells + invariant twins +
+                # direct-run programs all live here, and the warm-replay
+                # gate requires wave-1 executables to SURVIVE to the end
+                # of the run (the 64-entry default evicts them on big
+                # specs).
+                cache=ExecutableCache(
+                    max_entries=max(64, 6 * expected_cells),
+                ),
+            )
+        self.service = service
+        self._own_workdir = workdir is None
+        self._workdir = Path(
+            workdir if workdir is not None
+            else tempfile.mkdtemp(prefix="dopt-scenarios-")
+        )
+        # Served-run memo: identical configs (a twin equal to another
+        # cell, the explicit-defaults twin of an already-run cell) run
+        # once. ExperimentConfig is frozen/hashable. Direct runs keep
+        # their own memo (different program shapes — see run_direct).
+        self._served: dict[ExperimentConfig, Any] = {}
+        self._direct: dict[ExperimentConfig, Any] = {}
+
+    # ------------------------------------------------------------ plumbing
+    def workdir(self, name: str) -> str:
+        path = self._workdir / name
+        path.mkdir(parents=True, exist_ok=True)
+        return str(path)
+
+    def close(self) -> None:
+        if self._own_workdir:
+            shutil.rmtree(self._workdir, ignore_errors=True)
+
+    def __enter__(self) -> "ScenarioEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def run_served(self, config: ExperimentConfig):
+        """One config through the serving layer (memoized); returns its
+        ``BackendRunResult``. Raises ``EngineRunError`` on a failed run."""
+        hit = self._served.get(config)
+        if hit is not None:
+            return hit
+        rid = self.service.submit(config)
+        self.service.drain()
+        req = self.service.result(rid, timeout=60.0)
+        if req.status != "done":
+            raise EngineRunError(
+                f"served twin failed ({req.error}) for config "
+                f"{config.structural_hash()}"
+            )
+        self._served[config] = req.result
+        return req.result
+
+    def run_direct(self, config: ExperimentConfig, **kwargs):
+        """A direct backend run (the bitwise-reduction twins, final-state
+        and checkpoint invariants — comparisons/capabilities the served
+        cohort path does not provide). Shares the service's dataset memo
+        and executable cache; kwargs-free calls are memoized like served
+        twins."""
+        from distributed_optimization_tpu.backends.base import run_algorithm
+
+        if not kwargs and config in self._direct:
+            return self._direct[config]
+        ds, f_opt = self.service.dataset_for(config)
+        call_kwargs = dict(kwargs)
+        if config.backend == "jax" and config.tp_degree == 1:
+            call_kwargs.setdefault(
+                "executable_cache",
+                self.service.cache if self.service.cache is not None
+                else False,
+            )
+        result = run_algorithm(config, ds, f_opt, **call_kwargs)
+        if not kwargs:
+            self._direct[config] = result
+        return result
+
+    # ------------------------------------------------------------- running
+    def _expand(self, cell: Cell) -> list[ExperimentConfig]:
+        """A cell's serving requests: itself, or the R-replica seed
+        expansion with dataset + random-graph pinned so the coalescer can
+        reassemble the cohort."""
+        cfg = cell.config
+        assert cfg is not None
+        if cfg.replicas == 1:
+            return [cfg]
+        pins: dict[str, Any] = {
+            "replicas": 1, "data_seed": cfg.resolved_data_seed(),
+        }
+        if cfg.topology in RANDOM_TOPOLOGIES:
+            pins["topology_seed"] = cfg.resolved_topology_seed()
+        return [
+            cfg.replace(seed=seed, **pins) for seed in cfg.replica_seeds()
+        ]
+
+    def run(self) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        sample = generate(self.spec)
+        reg = metrics_registry()
+        gauges = _reset_scenario_gauges(reg)
+        counts = sample.counts()
+        gauges["sampled"].set(counts["cells"])
+        gauges["valid"].set(counts["valid"])
+        for rule, n in counts["rejected_by_rule"].items():
+            gauges["rejected"].set(n, rule=rule)
+        if not counts["rejected_by_rule"]:
+            gauges["rejected"].set(0)
+
+        # ---- one submission wave: let the coalescer see every cell ----
+        submissions: dict[int, list[str]] = {}
+        for cell in sample.valid_cells:
+            submissions[cell.index] = [
+                self.service.submit(cfg) for cfg in self._expand(cell)
+            ]
+        self.service.drain()
+
+        rows: list[dict[str, Any]] = []
+        n_checks = n_failures = n_run_errors = 0
+        by_invariant: dict[str, dict[str, int]] = {}
+        for cell in sample.cells:
+            row = cell.row()
+            if not cell.valid:
+                rows.append(row)
+                continue
+            requests = [
+                self.service.result(rid, timeout=60.0)
+                for rid in submissions[cell.index]
+            ]
+            failed = [r for r in requests if r.status != "done"]
+            if failed:
+                n_run_errors += 1
+                row["run_error"] = failed[0].error
+                rows.append(row)
+                continue
+            results = [r.result for r in requests]
+            self._served.setdefault(requests[0].config, results[0])
+            row["serving"] = requests[0].serving_block()
+            ctx = CellContext(
+                cell=cell, config=cell.config, results=results,
+                requests=requests, engine=self,
+                envelopes=self.spec.envelopes,
+            )
+            row["invariants"] = []
+            for inv in applicable_invariants(
+                cell.config, cell.fields, restrict=self.spec.invariants
+            ):
+                try:
+                    res = inv.check(ctx)
+                except EngineRunError as e:
+                    res_dict = {"name": inv.name, "passed": False,
+                                "detail": {"twin_error": str(e)}}
+                else:
+                    res_dict = res.to_dict()
+                n_checks += 1
+                slot = by_invariant.setdefault(
+                    inv.name, {"checks": 0, "failures": 0}
+                )
+                slot["checks"] += 1
+                if not res_dict["passed"]:
+                    n_failures += 1
+                    slot["failures"] += 1
+                    _log.warning(
+                        "cell %d (%s): invariant %s FAILED: %s",
+                        cell.index, cell.config.structural_hash(),
+                        inv.name, res_dict["detail"],
+                    )
+                row["invariants"].append(res_dict)
+            rows.append(row)
+        gauges["checks"].set(n_checks)
+        gauges["failures"].set(n_failures)
+
+        replay = self._warm_replay(sample, submissions)
+
+        stats = self.service.stats()
+        serving = {
+            "cohorts": stats["cohorts"],
+            "requests_done": stats["requests_done"],
+            "requests_failed": stats["requests_failed"],
+            "cache": {
+                k: stats["cache"].get(k)
+                for k in ("hits", "misses", "compile_seconds_saved")
+            },
+        }
+        serving["any_coalesced_cohort"] = any(
+            (r.get("serving") or {}).get("coalesced") for r in rows
+        )
+        report = {
+            "spec": {
+                "name": self.spec.name, "seed": self.spec.seed,
+                "mode": self.spec.mode, "axes": list(self.spec.axis_names),
+                "description": self.spec.description,
+            },
+            "counts": counts,
+            "invariants": {
+                "checks": n_checks, "failures": n_failures,
+                "by_name": by_invariant,
+            },
+            "serving": serving,
+            "warm_replay": replay,
+            "gates": {
+                "validity_agreement": True,  # generator aborts otherwise
+                "all_cells_completed": n_run_errors == 0,
+                "all_invariants_passed": n_failures == 0,
+                "warm_replay_ok": (
+                    not replay["attempted"]
+                    or (replay["bitwise"] and replay["cache_hit"])
+                ),
+            },
+            "cells": rows,
+            "wall_seconds": time.perf_counter() - t0,
+        }
+        return report
+
+    def _warm_replay(self, sample: MatrixSample, submissions) -> dict:
+        """Re-submit one structural class's wave-1 requests verbatim and
+        require the repeat to be served WARM (zero compile — the
+        executable cache) and BITWISE equal to the first wave.
+
+        This is the serving-identity reduction the matrix rides on: a
+        repeated identical wave must cut an identical cohort, reuse its
+        compiled program, and reproduce its trajectories exactly. The
+        replayed group is the first jax-backed class in submission order
+        (numpy/cpp runs have no compiled program to be warm about)."""
+        from distributed_optimization_tpu.serving.coalescer import (
+            structural_group_key,
+        )
+
+        groups: dict[tuple, list] = {}
+        order: list[tuple] = []
+        for cell in sample.valid_cells:
+            for rid in submissions[cell.index]:
+                req = self.service.get(rid)
+                if req.status != "done":
+                    continue
+                key = structural_group_key(req.config)
+                if key not in groups:
+                    groups[key] = []
+                    order.append(key)
+                groups[key].append(req)
+        chosen = None
+        for key in order:
+            reqs = groups[key]
+            if (
+                reqs[0].config.backend == "jax"
+                and reqs[0].config.tp_degree == 1
+                # plan_cohorts chunks groups at max_cohort; replaying a
+                # chunked group would cut different cohorts than wave 1.
+                and len(reqs) <= self.service.options.max_cohort
+            ):
+                chosen = reqs
+                break
+        if chosen is None:
+            return {"attempted": False}
+        replay_ids = [self.service.submit(r.config) for r in chosen]
+        self.service.drain()
+        import numpy as np
+
+        bitwise = True
+        warm = True
+        for first, rid in zip(chosen, replay_ids):
+            again = self.service.result(rid, timeout=60.0)
+            if again.status != "done":
+                bitwise = warm = False
+                break
+            bitwise = bitwise and bool(np.array_equal(
+                again.result.history.objective,
+                first.result.history.objective,
+            ))
+            warm = warm and (
+                again.result.history.compile_seconds == 0.0
+            )
+        return {
+            "attempted": True,
+            "structural_hash": chosen[0].config.structural_hash(),
+            "size": len(chosen),
+            "bitwise": bool(bitwise),
+            "cache_hit": bool(warm),
+        }
+
+
+def run_scenarios(spec: ScenarioSpec, **kwargs) -> dict[str, Any]:
+    """Convenience wrapper: build an engine, run, clean up."""
+    with ScenarioEngine(spec, **kwargs) as engine:
+        return engine.run()

@@ -1,0 +1,201 @@
+"""Cell generation: enumerate or property-sample the composition matrix.
+
+A *cell* is one assignment of every spec axis — merged over the spec's
+``base`` into a (partial) ``ExperimentConfig`` field map — classified by
+the validity table. Two modes (``spec.mode``):
+
+- ``enumerate``: the full cartesian product of axis settings, rejected
+  up front when it exceeds ``max_cells`` (the spec should sample
+  instead).
+- ``sample``: seeded property sampling — a ``random.Random(spec.seed)``
+  stream draws one setting per axis until ``spec.sample`` DISTINCT cells
+  exist (or the matrix is exhausted). The draw sequence is a pure
+  function of (spec axes order, seed), so a spec names a reproducible
+  cell set: same spec file, same cells, every machine.
+
+Valid cells get a constructed ``ExperimentConfig``; any disagreement
+between the validity table and construction raises
+``ValidityDivergenceError`` loudly — the generator is the belt-and-braces
+runtime enforcement of the drift contract tests pin
+(``validity.cross_check``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import random
+from collections import Counter
+from typing import Any, Optional
+
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.scenarios.spec import ScenarioSpec, SpecError
+from distributed_optimization_tpu.scenarios.validity import (
+    Verdict,
+    explain,
+    full_fields,
+)
+
+
+class ValidityDivergenceError(AssertionError):
+    """The validity table and ``ExperimentConfig`` construction disagreed
+    about a cell — the drift the agreement contract exists to catch."""
+
+
+@dataclasses.dataclass
+class Cell:
+    """One classified cell of the matrix."""
+
+    index: int
+    settings: dict[str, dict[str, Any]]  # axis -> chosen field group
+    fields: dict[str, Any]  # merged base + settings (partial overrides)
+    verdict: Verdict
+    config: Optional[ExperimentConfig] = None  # constructed when valid
+
+    @property
+    def valid(self) -> bool:
+        return self.verdict.valid
+
+    def row(self) -> dict[str, Any]:
+        """JSON-safe report row (non-default overrides only)."""
+        out: dict[str, Any] = {
+            "index": self.index,
+            "overrides": dict(self.fields),
+            "valid": self.verdict.valid,
+        }
+        if not self.verdict.valid:
+            out["rule"] = self.verdict.rule
+            out["reason"] = self.verdict.reason
+        if self.config is not None:
+            out["structural_hash"] = self.config.structural_hash()
+        return out
+
+
+@dataclasses.dataclass
+class MatrixSample:
+    """The generated cell set plus its accounting."""
+
+    spec: ScenarioSpec
+    cells: list[Cell]
+    exhausted: bool = False  # sample mode ran out of distinct cells
+
+    @property
+    def valid_cells(self) -> list[Cell]:
+        return [c for c in self.cells if c.valid]
+
+    def counts(self) -> dict[str, Any]:
+        rejected = Counter(
+            c.verdict.rule for c in self.cells if not c.valid
+        )
+        return {
+            "cells": len(self.cells),
+            "valid": sum(1 for c in self.cells if c.valid),
+            "rejected": sum(1 for c in self.cells if not c.valid),
+            "rejected_by_rule": dict(sorted(rejected.items())),
+        }
+
+
+def merge_cell_fields(
+    spec: ScenarioSpec, choice: dict[str, dict[str, Any]],
+) -> dict[str, Any]:
+    """Base + one setting per axis, with axis-collision detection: two
+    axes that set the same config field make the spec ambiguous (which
+    wins would depend on axis order), so that is a spec error, not a
+    silent override. Axes legitimately override ``base``."""
+    fields = dict(spec.base)
+    owner: dict[str, str] = {}
+    for axis, setting in choice.items():
+        for key, value in setting.items():
+            if key in owner:
+                raise SpecError(
+                    f"axes {owner[key]!r} and {axis!r} both set config "
+                    f"field {key!r}; fold them into one axis",
+                    field=key,
+                )
+            owner[key] = axis
+            fields[key] = value
+    return fields
+
+
+def _classify(spec: ScenarioSpec, index: int,
+              choice: dict[str, dict[str, Any]]) -> Cell:
+    fields = merge_cell_fields(spec, choice)
+    verdict = explain(full_fields(fields))
+    config = None
+    error = ExperimentConfig.construction_error(full_fields(fields))
+    if verdict.valid != (error is None):
+        raise ValidityDivergenceError(
+            f"cell {index} of spec {spec.name!r}: validity table says "
+            f"{'valid' if verdict.valid else f'invalid ({verdict.rule})'} "
+            f"but construction says "
+            f"{'valid' if error is None else f'invalid ({error})'} — "
+            f"fields {fields}"
+        )
+    if verdict.valid:
+        config = ExperimentConfig(**full_fields(fields))
+    return Cell(index=index, settings=dict(choice), fields=fields,
+                verdict=verdict, config=config)
+
+
+def enumerate_cells(spec: ScenarioSpec) -> MatrixSample:
+    total = spec.n_cells_total()
+    if total > spec.max_cells:
+        raise SpecError(
+            f"enumerating {spec.name!r} would build {total} cells "
+            f"(> max_cells {spec.max_cells}); use mode='sample' or raise "
+            "max_cells", field="max_cells",
+        )
+    names = spec.axis_names
+    cells = []
+    for index, combo in enumerate(
+        itertools.product(*(spec.axes[a] for a in names))
+    ):
+        cells.append(_classify(spec, index, dict(zip(names, combo))))
+    return MatrixSample(spec=spec, cells=cells)
+
+
+def sample_cells(spec: ScenarioSpec) -> MatrixSample:
+    """Seeded distinct-cell sampling (see module docstring). Draws until
+    ``spec.sample`` distinct cells exist; the matrix may hold fewer, in
+    which case every cell is returned and ``exhausted`` is set."""
+    names = spec.axis_names
+    total = spec.n_cells_total()
+    target = min(spec.sample, total)
+    rng = random.Random(spec.seed)
+    seen: set[tuple[int, ...]] = set()
+    cells: list[Cell] = []
+    # Distinctness makes a pure rejection loop slow near exhaustion; cap
+    # attempts and fall back to a seeded shuffle of the remainder.
+    max_attempts = max(50 * target, 1000)
+    attempts = 0
+    while len(cells) < target and attempts < max_attempts:
+        attempts += 1
+        key = tuple(
+            rng.randrange(len(spec.axes[a])) for a in names
+        )
+        if key in seen:
+            continue
+        seen.add(key)
+        choice = {
+            a: spec.axes[a][i] for a, i in zip(names, key)
+        }
+        cells.append(_classify(spec, len(cells), choice))
+    if len(cells) < target:
+        remainder = [
+            key for key in itertools.product(
+                *(range(len(spec.axes[a])) for a in names)
+            ) if key not in seen
+        ]
+        rng.shuffle(remainder)
+        for key in remainder[: target - len(cells)]:
+            choice = {a: spec.axes[a][i] for a, i in zip(names, key)}
+            cells.append(_classify(spec, len(cells), choice))
+    return MatrixSample(
+        spec=spec, cells=cells, exhausted=len(cells) < spec.sample,
+    )
+
+
+def generate(spec: ScenarioSpec) -> MatrixSample:
+    if spec.mode == "enumerate":
+        return enumerate_cells(spec)
+    return sample_cells(spec)

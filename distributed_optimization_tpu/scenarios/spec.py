@@ -1,0 +1,303 @@
+"""Scenario specs: declarative descriptions of a composition-matrix sweep.
+
+A spec is a JSON (or YAML, when the interpreter has a yaml module) object
+that names a region of the composition matrix::
+
+    {
+      "name": "robustness-sweep",
+      "seed": 7,
+      "mode": "sample",            // or "enumerate"
+      "sample": 200,               // cells to draw in sample mode
+      "base": {"n_workers": 16, "n_iterations": 200, "eval_every": 50},
+      "axes": {
+        "algorithm": ["dsgd", "gradient_tracking"],
+        "faults": [{}, {"edge_drop_prob": 0.2, "burst_len": 4.0}],
+        "byzantine": [{}, {"attack": "sign_flip", "n_byzantine": 1,
+                            "aggregation": "trimmed_mean", "robust_b": 1}]
+      }
+    }
+
+An axis whose name is an ``ExperimentConfig`` field takes scalar values;
+any other axis name is a composite label whose values are field dicts
+(one knob group per axis — the 10-axis decomposition in
+``validity.AXES``). The cartesian product of axis settings over ``base``
+is the cell matrix; ``scenarios.generator`` enumerates or
+property-samples it and ``scenarios.validity`` classifies every cell.
+
+Error contract (ISSUE-12 satellite): every malformed spec — unreadable
+file, bad JSON/YAML, unknown top-level key, unknown axis field, wrong
+value type, conflicting axes — raises ``SpecError`` with the offending
+field named and (for typos) the nearest valid field suggested. The CLI
+maps these to structured stderr lines; a user never sees a traceback for
+a bad spec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import difflib
+import json
+from pathlib import Path
+from typing import Any, Mapping, Optional
+
+from distributed_optimization_tpu.scenarios.validity import (
+    CONFIG_FIELDS,
+    UnknownFieldError,
+)
+
+MODES = ("sample", "enumerate")
+
+SPEC_FIELDS = (
+    "name", "description", "seed", "mode", "sample", "max_cells", "base",
+    "axes", "invariants", "envelopes",
+)
+
+# Invariant names a spec may restrict to (mirrors invariants.CATALOG —
+# kept as a plain tuple so spec parsing stays import-light).
+KNOWN_INVARIANTS = (
+    "finite_gap", "gt_tracking", "robust_envelope", "bhat_degradation",
+    "reduction_burst", "reduction_churn", "reduction_zero_budget",
+    "reduction_explicit_defaults", "checkpoint_resume", "replica_cohort",
+)
+
+
+class SpecError(ValueError):
+    """A malformed scenario spec: the message names the offending field
+    (and the nearest valid one for typos); ``field``/``suggestion`` carry
+    the same facts structurally."""
+
+    def __init__(
+        self, message: str, *, field: Optional[str] = None,
+        suggestion: Optional[str] = None,
+    ):
+        self.field = field
+        self.suggestion = suggestion
+        super().__init__(message)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """One parsed, validated scenario spec (see module docstring)."""
+
+    name: str
+    axes: dict[str, tuple[dict[str, Any], ...]]
+    base: dict[str, Any] = dataclasses.field(default_factory=dict)
+    seed: int = 0
+    mode: str = "sample"
+    sample: int = 100
+    max_cells: int = 20_000
+    invariants: Optional[tuple[str, ...]] = None  # None = auto per cell
+    envelopes: dict[str, float] = dataclasses.field(default_factory=dict)
+    description: str = ""
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.axes)
+
+    def n_cells_total(self) -> int:
+        total = 1
+        for values in self.axes.values():
+            total *= len(values)
+        return total
+
+    def to_dict(self) -> dict[str, Any]:
+        out = dataclasses.asdict(self)
+        out["axes"] = {k: list(v) for k, v in self.axes.items()}
+        return out
+
+
+def _suggest(name: str, candidates) -> Optional[str]:
+    matches = difflib.get_close_matches(name, list(candidates), n=1)
+    return matches[0] if matches else None
+
+
+def _reject_unknown(name: str, candidates, *, context: str) -> SpecError:
+    suggestion = _suggest(name, candidates)
+    hint = f"; did you mean {suggestion!r}?" if suggestion else ""
+    return SpecError(
+        f"unknown {context} {name!r}{hint}", field=name,
+        suggestion=suggestion,
+    )
+
+
+def _check_fields_dict(d: Mapping, *, context: str) -> dict[str, Any]:
+    """Validate a {config_field: value} mapping; unknown fields get the
+    nearest-valid-field suggestion (the UnknownFieldError contract)."""
+    if not isinstance(d, Mapping):
+        raise SpecError(
+            f"{context} must be an object of ExperimentConfig fields, got "
+            f"{type(d).__name__}", field=context,
+        )
+    for key, value in d.items():
+        if key not in CONFIG_FIELDS:
+            try:
+                raise UnknownFieldError(str(key), context=f"{context} field")
+            except UnknownFieldError as e:
+                raise SpecError(str(e), field=str(key),
+                                suggestion=e.suggestion) from None
+        if isinstance(value, (dict, list)):
+            raise SpecError(
+                f"{context} field {key!r} must be a scalar, got "
+                f"{type(value).__name__}", field=str(key),
+            )
+    return dict(d)
+
+
+def _parse_axis(name: str, values: Any) -> tuple[dict[str, Any], ...]:
+    """One axis: a list of settings. A config-field axis takes scalars
+    (or single-field dicts); a composite axis takes field dicts."""
+    if not isinstance(values, list) or not values:
+        raise SpecError(
+            f"axis {name!r} must be a non-empty list of settings, got "
+            f"{type(values).__name__}", field=name,
+        )
+    is_field = name in CONFIG_FIELDS
+    settings: list[dict[str, Any]] = []
+    for i, value in enumerate(values):
+        if isinstance(value, Mapping):
+            settings.append(
+                _check_fields_dict(value, context=f"axis {name!r}[{i}]")
+            )
+        elif is_field:
+            if isinstance(value, list):
+                raise SpecError(
+                    f"axis {name!r}[{i}] must be a scalar "
+                    f"{name} value, got a list", field=name,
+                )
+            settings.append({name: value})
+        elif any(isinstance(v, Mapping) for v in values):
+            # The axis is clearly composite (other settings are field
+            # dicts) — blame the odd scalar value, not the axis name.
+            raise SpecError(
+                f"axis {name!r}[{i}] must be a field object "
+                f"({{config_field: value}}) like the axis's other "
+                f"settings, got {value!r}", field=name,
+            )
+        else:
+            # Every setting is a scalar but the axis names no config
+            # field: either a field-name typo (suggest the nearest) or a
+            # composite axis whose settings forgot their dict form.
+            err = _reject_unknown(name, CONFIG_FIELDS, context="axis")
+            raise SpecError(
+                f"{err} — scalar settings are only valid when the axis "
+                "names the config field it sweeps; composite axes take "
+                "field objects ({config_field: value})",
+                field=name, suggestion=err.suggestion,
+            )
+    return tuple(settings)
+
+
+def parse_spec(obj: Any, *, origin: str = "<spec>") -> ScenarioSpec:
+    """Validate a decoded spec object into a ``ScenarioSpec`` (raises
+    ``SpecError`` — see the module docstring's error contract)."""
+    if not isinstance(obj, Mapping):
+        raise SpecError(
+            f"{origin}: spec must be a JSON object, got "
+            f"{type(obj).__name__}"
+        )
+    for key in obj:
+        if key not in SPEC_FIELDS:
+            raise _reject_unknown(str(key), SPEC_FIELDS,
+                                  context="spec field")
+    name = obj.get("name")
+    if not isinstance(name, str) or not name:
+        raise SpecError(
+            "spec needs a non-empty string 'name'", field="name"
+        )
+    mode = obj.get("mode", "sample")
+    if mode not in MODES:
+        raise SpecError(
+            f"mode must be one of {MODES}, got {mode!r}", field="mode",
+            suggestion=_suggest(str(mode), MODES),
+        )
+    seed = obj.get("seed", 0)
+    if not isinstance(seed, int) or isinstance(seed, bool):
+        raise SpecError(
+            f"seed must be an integer, got {seed!r}", field="seed"
+        )
+    sample = obj.get("sample", 100)
+    if not isinstance(sample, int) or isinstance(sample, bool) or sample < 1:
+        raise SpecError(
+            f"sample must be a positive integer, got {sample!r}",
+            field="sample",
+        )
+    max_cells = obj.get("max_cells", 20_000)
+    if (not isinstance(max_cells, int) or isinstance(max_cells, bool)
+            or max_cells < 1):
+        raise SpecError(
+            f"max_cells must be a positive integer, got {max_cells!r}",
+            field="max_cells",
+        )
+    base = _check_fields_dict(obj.get("base", {}), context="base")
+    axes_obj = obj.get("axes")
+    if not isinstance(axes_obj, Mapping) or not axes_obj:
+        raise SpecError(
+            "spec needs a non-empty 'axes' object "
+            "({axis_name: [settings, ...]})", field="axes",
+        )
+    axes = {
+        str(axis): _parse_axis(str(axis), values)
+        for axis, values in axes_obj.items()
+    }
+    invariants = obj.get("invariants")
+    if invariants is not None:
+        if not isinstance(invariants, list):
+            raise SpecError(
+                "invariants must be a list of invariant names",
+                field="invariants",
+            )
+        for inv in invariants:
+            if inv not in KNOWN_INVARIANTS:
+                raise _reject_unknown(str(inv), KNOWN_INVARIANTS,
+                                      context="invariant")
+        invariants = tuple(invariants)
+    envelopes = obj.get("envelopes", {})
+    if not isinstance(envelopes, Mapping):
+        raise SpecError("envelopes must be an object of numeric bounds",
+                        field="envelopes")
+    for key, value in envelopes.items():
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            raise SpecError(
+                f"envelope {key!r} must be a number, got {value!r}",
+                field=str(key),
+            )
+    description = obj.get("description", "")
+    if not isinstance(description, str):
+        raise SpecError("description must be a string",
+                        field="description")
+    return ScenarioSpec(
+        name=name, axes=axes, base=base, seed=seed, mode=mode,
+        sample=sample, max_cells=max_cells, invariants=invariants,
+        envelopes={str(k): float(v) for k, v in envelopes.items()},
+        description=description,
+    )
+
+
+def load_spec(path) -> ScenarioSpec:
+    """Read + parse a spec file. JSON always; ``.yaml``/``.yml`` when a
+    yaml module is importable (the container may not ship one — the
+    rejection says so instead of ImportError-ing)."""
+    p = Path(path)
+    try:
+        text = p.read_text()
+    except OSError as e:
+        raise SpecError(f"cannot read spec {p}: {e}") from e
+    if p.suffix.lower() in (".yaml", ".yml"):
+        try:
+            import yaml  # type: ignore[import-not-found]
+        except ImportError:
+            raise SpecError(
+                f"{p.name}: YAML specs need a yaml module, which this "
+                "environment does not ship — use the JSON spec format "
+                "(docs/SCENARIOS.md)"
+            ) from None
+        try:
+            obj = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise SpecError(f"{p.name}: malformed YAML: {e}") from e
+    else:
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise SpecError(f"{p.name}: malformed JSON: {e}") from e
+    return parse_spec(obj, origin=p.name)

@@ -1,0 +1,231 @@
+"""Retrying stdlib HTTP client for the serving daemon.
+
+The daemon documents two RETRYABLE server states — 429 ``queue_full``
+(bounded-queue backpressure; ``serving/daemon.py``) and plain connection
+failures (a daemon restarting between submit and result — the chaos
+harness's kill/restart mode) — but until ISSUE-12 no client implemented
+the retry, so every caller either string-matched errors or died on the
+first refused connection. ``RetryingClient`` is that client: bounded
+retries with exponential backoff and seeded jitter on
+
+- HTTP 429 and 503 (backpressure / transient unavailability), and
+- connection-level failures (refused, reset, broken pipe) — the restart
+  window.
+
+Everything else — 400 invalid configs, 404 unknown ids, 500 run
+failures — is a STRUCTURED answer, not a transport fault: it is returned
+as ``(status, payload)`` for the caller to assert on, never retried
+(retrying a permanently invalid config would just hammer the daemon) and
+never raised as a bare traceback.
+
+Stdlib only (urllib), like the daemon itself. Used by the chaos harness
+(``scenarios/chaos.py``), ``examples/serve_smoke.py`` and
+``examples/observatory_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Iterator, Optional
+
+from distributed_optimization_tpu.log import get_logger
+
+_log = get_logger("serving.client")
+
+RETRYABLE_STATUSES = (429, 503)
+
+
+class RetriesExhaustedError(ConnectionError):
+    """The bounded retry budget ran out; carries the last failure."""
+
+    def __init__(self, message: str, *, last_status: Optional[int] = None):
+        self.last_status = last_status
+        super().__init__(message)
+
+
+class RetryingClient:
+    """Bounded-retry HTTP client for one daemon base URL.
+
+    ``max_retries`` counts RE-attempts (0 = single try). Backoff for
+    attempt k sleeps ``min(cap, base * 2**k)`` scaled by a jitter factor
+    in [0.5, 1.0] drawn from a seeded stream — deterministic in tests,
+    and never synchronized across clients in production (the thundering
+    herd a fixed schedule would re-create against a restarting daemon).
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        max_retries: int = 5,
+        backoff_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        timeout_s: float = 300.0,
+        seed: Optional[int] = None,
+        sleep=time.sleep,
+    ):
+        self.base_url = base_url.rstrip("/")
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.timeout_s = timeout_s
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self.n_retries = 0  # lifetime counter (chaos harness reads it)
+
+    # ------------------------------------------------------------ plumbing
+    def _delay(self, attempt: int) -> float:
+        base = min(self.backoff_cap_s, self.backoff_s * (2.0 ** attempt))
+        return base * (0.5 + 0.5 * self._rng.random())
+
+    def _once(self, method: str, path: str, body, timeout: float):
+        data = None
+        headers = {}
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            self.base_url + path, data=data, headers=headers, method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # Structured non-2xx answer: read the daemon's JSON error body.
+            try:
+                payload = json.loads(e.read())
+            except (json.JSONDecodeError, OSError):
+                payload = {"error": "http_error", "detail": str(e)}
+            return e.code, payload
+
+    def request(
+        self, method: str, path: str, body=None,
+        timeout: Optional[float] = None,
+    ) -> tuple[int, Any]:
+        """One request with the retry policy; returns (status, payload)."""
+        timeout = self.timeout_s if timeout is None else timeout
+        last_status: Optional[int] = None
+        last_error: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                status, payload = self._once(method, path, body, timeout)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                # Connection-level failure (refused/reset/daemon gone):
+                # the restart window — retryable.
+                last_error, last_status = e, None
+            else:
+                if status not in RETRYABLE_STATUSES:
+                    return status, payload
+                last_error, last_status = None, status
+            if attempt == self.max_retries:
+                break
+            delay = self._delay(attempt)
+            self.n_retries += 1
+            _log.debug(
+                "retrying %s %s after %s (attempt %d/%d, sleep %.3fs)",
+                method, path,
+                last_status if last_status is not None else last_error,
+                attempt + 1, self.max_retries, delay,
+            )
+            self._sleep(delay)
+        why = (
+            f"HTTP {last_status}" if last_status is not None
+            else f"{type(last_error).__name__}: {last_error}"
+        )
+        raise RetriesExhaustedError(
+            f"{method} {self.base_url + path} failed after "
+            f"{self.max_retries + 1} attempts ({why})",
+            last_status=last_status,
+        )
+
+    # ---------------------------------------------------------- endpoints
+    def submit(self, config: dict, timeout: Optional[float] = None):
+        return self.request("POST", "/v1/submit", config, timeout)
+
+    def run(self, config: dict, timeout: Optional[float] = None):
+        # The socket timeout gets headroom over the server's long-poll
+        # window (like result()): with both equal, a run finishing near
+        # the window would look like a connection failure and be RETRIED
+        # — re-submitting and re-executing the whole simulation.
+        t = self.timeout_s if timeout is None else timeout
+        return self.request(
+            "POST", f"/v1/run?timeout={t:g}", config, t + 30.0,
+        )
+
+    def result(self, request_id: str, timeout: Optional[float] = None):
+        t = self.timeout_s if timeout is None else timeout
+        return self.request(
+            "GET", f"/v1/result/{request_id}?timeout={t:g}", None, t + 30.0,
+        )
+
+    def status(self, timeout: Optional[float] = None):
+        return self.request("GET", "/v1/status", None, timeout)
+
+    def shutdown(self, timeout: Optional[float] = None):
+        return self.request("POST", "/v1/shutdown", None, timeout)
+
+    def metrics_text(self, timeout: Optional[float] = None) -> str:
+        """GET /metrics (Prometheus text, not JSON). Same retry policy
+        as ``request``: connection failures and 429/503 retry with
+        backoff; any other HTTP error is a structured answer and is
+        re-raised untouched (never retried)."""
+        timeout = self.timeout_s if timeout is None else timeout
+        for attempt in range(self.max_retries + 1):
+            try:
+                with urllib.request.urlopen(
+                    self.base_url + "/metrics", timeout=timeout
+                ) as r:
+                    return r.read().decode()
+            except urllib.error.HTTPError as e:
+                # HTTPError subclasses URLError/OSError — it must be
+                # classified FIRST or structured 404/500 answers would
+                # be hammered through the whole retry budget.
+                if e.code not in RETRYABLE_STATUSES:
+                    raise
+                last = f"HTTP {e.code}"
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last = f"{type(e).__name__}: {e}"
+            if attempt == self.max_retries:
+                raise RetriesExhaustedError(
+                    f"GET /metrics failed after {attempt + 1} attempts "
+                    f"({last})"
+                )
+            self.n_retries += 1
+            self._sleep(self._delay(attempt))
+        raise AssertionError("unreachable")
+
+    def progress_stream(
+        self, request_id: str, *, after: int = -1,
+        timeout: Optional[float] = None,
+    ):
+        """Open ``/v1/progress/<id>`` and return the RAW response (the
+        connection-close-terminated JSONL stream): callers that need the
+        headers — e.g. asserting the ``application/x-ndjson`` content
+        type — read them here, then iterate lines. The caller owns
+        closing it (use as a context manager)."""
+        t = self.timeout_s if timeout is None else timeout
+        return urllib.request.urlopen(
+            f"{self.base_url}/v1/progress/{request_id}"
+            f"?timeout={t:g}&after={after}",
+            timeout=t + 30.0,
+        )
+
+    def progress_events(
+        self, request_id: str, *, after: int = -1,
+        timeout: Optional[float] = None,
+    ) -> Iterator[dict]:
+        """Stream ``/v1/progress/<id>`` as decoded JSONL events (no
+        mid-stream retry — a reconnect would be a NEW request with
+        ``after=`` set)."""
+        with self.progress_stream(
+            request_id, after=after, timeout=timeout
+        ) as resp:
+            for line in resp:
+                if line.strip():
+                    yield json.loads(line)

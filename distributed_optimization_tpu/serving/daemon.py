@@ -56,6 +56,16 @@ _log = get_logger("serving.daemon")
 DEFAULT_PORT = 8421
 DEFAULT_RUN_TIMEOUT_S = 300.0
 MAX_BODY_BYTES = 1_000_000  # a config object is ~1 KB; bound hostile bodies
+# Per-connection socket timeout (ISSUE-12 satellite). Without one, a
+# client that connects and never completes a request — or opens a
+# streaming response and never reads — pins its handler thread FOREVER
+# (rfile.readline / wfile.write block indefinitely), and a handful of
+# stalled clients exhaust the threaded server. The timeout bounds every
+# blocking socket op; on expiry the read loop closes the connection and
+# the streaming writers bail out through their OSError handling. It must
+# comfortably exceed the heartbeat cadence so live progress streams are
+# never cut between events.
+DEFAULT_SOCKET_TIMEOUT_S = 75.0
 
 
 def _strict_json(obj) -> bytes:
@@ -72,6 +82,29 @@ class _Handler(BaseHTTPRequestHandler):
     server: "_Server"
 
     protocol_version = "HTTP/1.1"
+
+    def setup(self) -> None:
+        super().setup()
+        timeout = self.server.socket_timeout_s
+        if timeout and timeout > 0:
+            # Bounds EVERY blocking op on this connection (request reads,
+            # response and stream writes); http.server's read loop maps
+            # the read-side expiry to close_connection itself.
+            self.connection.settimeout(timeout)
+
+    def handle(self) -> None:
+        try:
+            super().handle()
+        except (TimeoutError, ConnectionError, OSError) as e:
+            # A write-side stall (client stopped reading) surfaces here
+            # once the kernel buffer fills and the socket timeout fires:
+            # log one debug line instead of a traceback; socketserver
+            # tears the connection down on return and the handler thread
+            # is reclaimed.
+            _log.debug(
+                "dropping stalled/broken connection from %s: %s",
+                self.client_address, e,
+            )
 
     def log_message(self, fmt, *args):  # route http.server chatter to our log
         _log.debug("%s " + fmt, self.address_string(), *args)
@@ -223,8 +256,12 @@ class _Handler(BaseHTTPRequestHandler):
             for payload in req.progress.follow(after, timeout=timeout):
                 self.wfile.write(_strict_json(payload))
                 self.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError):
-            pass  # client went away mid-stream; nothing to clean up
+        except (TimeoutError, ConnectionError, OSError):
+            # Client went away mid-stream, or stopped reading long enough
+            # for the connection's socket timeout to fire (a stalled
+            # reader must not pin this streaming thread): nothing to
+            # clean up, the stream just ends.
+            pass
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         path = urlparse(self.path).path.rstrip("/")
@@ -275,9 +312,13 @@ class _Server(ThreadingHTTPServer):
     # Serving requests block for seconds; keep the accept queue generous.
     request_queue_size = 32
 
-    def __init__(self, addr, service: SimulationService):
+    def __init__(
+        self, addr, service: SimulationService,
+        socket_timeout_s: float = DEFAULT_SOCKET_TIMEOUT_S,
+    ):
         super().__init__(addr, _Handler)
         self.service = service
+        self.socket_timeout_s = socket_timeout_s
 
     def initiate_shutdown(self) -> None:
         # shutdown() must not run on a handler thread (it joins the serve
@@ -298,9 +339,12 @@ class ServingDaemon:
         options: Optional[ServingOptions] = None,
         *,
         service: Optional[SimulationService] = None,
+        socket_timeout_s: float = DEFAULT_SOCKET_TIMEOUT_S,
     ):
         self.service = service or SimulationService(options)
-        self._server = _Server((host, port), self.service)
+        self._server = _Server(
+            (host, port), self.service, socket_timeout_s=socket_timeout_s,
+        )
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -367,6 +411,12 @@ def main(argv=None) -> int:
                    help="replica-axis cap per coalesced run_batch call")
     p.add_argument("--max-pending", type=int, default=1024,
                    help="queue bound; submits beyond it get a 400")
+    p.add_argument("--socket-timeout", type=float,
+                   default=DEFAULT_SOCKET_TIMEOUT_S,
+                   help="per-connection socket timeout in seconds; a "
+                        "client that stalls a read or write longer than "
+                        "this is dropped so it cannot pin a handler "
+                        "thread (0 disables)")
     p.add_argument("--platform", choices=("tpu", "cpu", "auto"),
                    default="auto",
                    help="force the JAX platform before first use")
@@ -387,6 +437,7 @@ def main(argv=None) -> int:
             max_cohort=args.max_cohort,
             max_pending=args.max_pending,
         ),
+        socket_timeout_s=args.socket_timeout,
     )
     try:
         daemon.serve_forever()

@@ -374,6 +374,14 @@ class SimulationService:
             total += self.process_once()
         return total
 
+    def dataset_for(self, cfg: ExperimentConfig):
+        """Public dataset access sharing the service memo: the scenario
+        engine's direct backend runs (final-state and checkpoint
+        invariants) must consume the SAME dataset instance its served
+        cells ran on, or cross-run bitwise comparisons would compare
+        different problems."""
+        return self._dataset_for(cfg)
+
     def _dataset_for(self, cfg: ExperimentConfig):
         """Dataset + reference optimum for a request, memoized on the
         fields that determine them (bounded FIFO — datasets are cheap to

@@ -23,20 +23,15 @@ import json
 import os
 import sys
 import tempfile
-import urllib.request
 from pathlib import Path
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _get_json(url, timeout=300.0):
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.status, json.loads(r.read())
-
-
 def main() -> int:
     from distributed_optimization_tpu.config import ExperimentConfig
     from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.client import RetryingClient
     from distributed_optimization_tpu.serving.daemon import ServingDaemon
     from distributed_optimization_tpu.serving.service import (
         ServingOptions,
@@ -56,16 +51,14 @@ def main() -> int:
     )
     daemon.start()
     url = daemon.url
+    # The retrying serving client (ISSUE-12 satellite) drives the whole
+    # smoke: submits, status polls, /metrics scrapes, progress streams.
+    client = RetryingClient(url, max_retries=4, seed=0)
     print(f"[observatory-smoke] daemon at {url}", file=sys.stderr)
     try:
         # --- submit and stream progress WHILE it runs -------------------
-        body = json.dumps(base.to_dict()).encode()
-        req = urllib.request.Request(
-            url + "/v1/submit", data=body,
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=30) as r:
-            sub = json.loads(r.read())
+        code, sub = client.submit(base.to_dict(), timeout=30)
+        assert code == 202, (code, sub)
         rid = sub["id"]
 
         # /metrics is scraped MID-RUN: on the first chunk heartbeat (the
@@ -74,19 +67,16 @@ def main() -> int:
         # on that snapshot.
         mid_scrapes = []
         events = []
-        with urllib.request.urlopen(
-            url + f"/v1/progress/{rid}?timeout=300", timeout=300
-        ) as resp:
+        with client.progress_stream(rid, timeout=300) as resp:
             assert resp.headers["Content-Type"].startswith(
                 "application/x-ndjson"
             ), resp.headers["Content-Type"]
             for line in resp:
+                if not line.strip():
+                    continue
                 events.append(json.loads(line))
                 if events[-1]["kind"] == "chunk" and not mid_scrapes:
-                    with urllib.request.urlopen(
-                        url + "/metrics", timeout=30
-                    ) as r:
-                        mid_scrapes.append(r.read().decode())
+                    mid_scrapes.append(client.metrics_text(timeout=30))
 
         statuses = [e.get("status") for e in events if e.get("status")]
         assert statuses[0] == "queued" and statuses[-1] == "done", statuses
@@ -136,26 +126,21 @@ def main() -> int:
                 )
 
         # --- status: counters always present + bounded history ----------
-        code, st = _get_json(url + "/v1/status")
+        code, st = client.status()
         assert code == 200
         assert {"hits", "misses", "compile_seconds_saved"} <= set(st["cache"])
         assert st["history"]["bound"] == opts.max_done
         assert st["history"]["retained"] >= 1
 
         # --- observatory CLI over the served manifests -------------------
-        code, m1 = _get_json(url + f"/v1/result/{rid}?timeout=60")
+        code, m1 = client.result(rid, timeout=60)
         assert code == 200 and m1["kind"] == "run_trace"
         assert m1["provenance"]["jax_version"], m1["provenance"]
         assert m1["spans"], "manifest carries no spans"
-        req2 = urllib.request.Request(
-            url + "/v1/run?timeout=300",
-            data=json.dumps(
-                base.replace(learning_rate_eta0=0.11).to_dict()
-            ).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
+        code, m2 = client.run(
+            base.replace(learning_rate_eta0=0.11).to_dict(), timeout=300,
         )
-        with urllib.request.urlopen(req2, timeout=300) as r:
-            m2 = json.loads(r.read())
+        assert code == 200, (code, m2)
 
         with tempfile.TemporaryDirectory() as td:
             a = Path(td) / "a.json"

@@ -1,0 +1,99 @@
+"""``make scenarios-smoke``: the scenario engine + chaos harness, CI-sized.
+
+The fast end-to-end check of ISSUE-12 (docs/SCENARIOS.md):
+
+1. a seeded PROPERTY SAMPLE over a mixed axis bank — validity-table
+   verdicts must agree with config construction on every drawn cell
+   (the generator enforces it; a divergence aborts loudly);
+2. the sample's valid cells run through the serving layer with the full
+   auto-selected invariant catalog minus the slow checkpoint one, plus
+   the warm-replay identity — all gates must pass;
+3. ONE operational chaos cycle: the daemon kill/restart mode (submit,
+   abrupt kill, restart over the same executable cache, warm
+   re-serve via the retrying client).
+
+Exit code 0 = all gates passed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_daemon_kill_restart,
+    )
+    from distributed_optimization_tpu.scenarios.engine import run_scenarios
+    from distributed_optimization_tpu.scenarios.spec import parse_spec
+
+    spec = parse_spec({
+        # sample == the matrix size: the seeded sampler walks the whole
+        # (small) matrix in draw order — still the property-sampling code
+        # path, with every assertion below deterministic.
+        "name": "scenarios-smoke", "seed": 7, "mode": "sample",
+        "sample": 14,
+        "base": {
+            "n_workers": 8, "n_samples": 300, "n_features": 8,
+            "n_informative_features": 5, "n_iterations": 60,
+            "eval_every": 20, "local_batch_size": 8, "dtype": "float64",
+        },
+        "axes": {
+            "learning_rate_eta0": [0.05, 0.08],
+            "scenario": [
+                {},
+                {"algorithm": "gradient_tracking"},
+                {"edge_drop_prob": 0.2},
+                {"straggler_prob": 0.15},
+                {"attack": "sign_flip", "n_byzantine": 1,
+                 "aggregation": "trimmed_mean", "robust_b": 1,
+                 "partition": "shuffled"},
+                {"replicas": 3},
+                # One INVALID composition on purpose: the smoke must see
+                # the validity table reject (and agree with construction).
+                {"algorithm": "extra", "local_steps": 4},
+            ],
+        },
+        "invariants": [
+            "finite_gap", "gt_tracking", "robust_envelope",
+            "bhat_degradation", "reduction_burst", "reduction_churn",
+            "reduction_explicit_defaults", "replica_cohort",
+        ],
+    })
+    report = run_scenarios(spec)
+    counts = report["counts"]
+    print(
+        f"[scenarios-smoke] {counts['cells']} cells sampled: "
+        f"{counts['valid']} valid, {counts['rejected']} rejected "
+        f"({list(counts['rejected_by_rule'])}), "
+        f"{report['invariants']['checks']} invariant checks, "
+        f"{report['invariants']['failures']} failures",
+        file=sys.stderr,
+    )
+    assert counts["rejected"] >= 1, (
+        "the smoke spec plants an invalid composition; the sampler "
+        "missed it"
+    )
+    assert counts["rejected_by_rule"].get("local_steps×algorithm"), (
+        counts["rejected_by_rule"]
+    )
+    assert all(report["gates"].values()), report["gates"]
+
+    record = chaos_daemon_kill_restart()
+    print(
+        f"[scenarios-smoke] chaos kill/restart: warm resubmit "
+        f"cache_hit={record.detail.get('resubmit_cache_hit')} "
+        f"compile={record.detail.get('resubmit_compile_seconds')}s",
+        file=sys.stderr,
+    )
+    assert record.passed, record.detail
+    print("[scenarios-smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

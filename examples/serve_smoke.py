@@ -19,33 +19,20 @@ Exit code 0 = all assertions passed.
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time
-import urllib.error
-import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def _post(url, body, timeout=300.0):
-    req = urllib.request.Request(
-        url, data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"}, method="POST",
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return r.status, json.loads(r.read())
-
-
-def _get(url, timeout=300.0):
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.status, json.loads(r.read())
 
 
 def main() -> int:
     from distributed_optimization_tpu.config import ExperimentConfig
     from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.client import (
+        RetriesExhaustedError,
+        RetryingClient,
+    )
     from distributed_optimization_tpu.serving.daemon import ServingDaemon
     from distributed_optimization_tpu.serving.service import (
         ServingOptions,
@@ -67,23 +54,24 @@ def main() -> int:
     )
     daemon.start()
     url = daemon.url
+    # The documented serving client (ISSUE-12 satellite): bounded retry
+    # with backoff + jitter on 429 backpressure and connection resets.
+    client = RetryingClient(url, max_retries=4, seed=0)
     print(f"[serve-smoke] daemon at {url}", file=sys.stderr)
     try:
         # --- submit 3 requests over the wire (2 structurally identical) --
-        code_a, sub_a = _post(url + "/v1/submit", base.to_dict())
-        code_b, sub_b = _post(
-            url + "/v1/submit",
-            base.replace(learning_rate_eta0=0.11).to_dict(),
+        code_a, sub_a = client.submit(base.to_dict())
+        code_b, sub_b = client.submit(
+            base.replace(learning_rate_eta0=0.11).to_dict()
         )
-        code_c, sub_c = _post(
-            url + "/v1/submit",
-            base.replace(topology="fully_connected").to_dict(),
+        code_c, sub_c = client.submit(
+            base.replace(topology="fully_connected").to_dict()
         )
         assert (code_a, code_b, code_c) == (202, 202, 202), "submit failed"
 
         manifests = {}
         for sub in (sub_a, sub_b, sub_c):
-            code, m = _get(url + f"/v1/result/{sub['id']}?timeout=300")
+            code, m = client.result(sub["id"], timeout=300)
             assert code == 200 and m["kind"] == "run_trace", (code, m)
             manifests[sub["id"]] = m
 
@@ -94,7 +82,7 @@ def main() -> int:
         assert sa["cohort_size"] == 2 and sa["coalesced"], sa
         assert sb["cohort_size"] == 2 and sb["coalesced"], sb
         assert sc["cohort_size"] == 1 and not sc["coalesced"], sc
-        code, st = _get(url + "/v1/status")
+        code, st = client.status()
         assert code == 200
         misses = st["cache"]["misses"]
         assert misses == 2, (
@@ -127,14 +115,18 @@ def main() -> int:
         print(f"[serve-smoke] parity OK (|dev| = {dev:.2e})", file=sys.stderr)
 
         # --- clean shutdown over the wire -------------------------------
-        code, body = _post(url + "/v1/shutdown", {})
+        code, body = client.shutdown()
         assert code == 200 and body["status"] == "shutting_down"
+        # A no-retry probe must see the daemon actually gone (the
+        # retrying client would keep trying — exactly what we do NOT
+        # want when asserting death).
+        probe = RetryingClient(url, max_retries=0)
         deadline = time.perf_counter() + 10.0
         stopped = False
         while time.perf_counter() < deadline:
             try:
-                _get(url + "/v1/status", timeout=1.0)
-            except (urllib.error.URLError, ConnectionError, OSError):
+                probe.status(timeout=1.0)
+            except RetriesExhaustedError:
                 stopped = True
                 break
             time.sleep(0.1)

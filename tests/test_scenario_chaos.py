@@ -1,0 +1,324 @@
+"""Chaos harness + serving-robustness satellites (ISSUE-12): operational
+fault injection, the daemon's per-connection socket timeout, and the
+retrying HTTP client."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_optimization_tpu.serving.client import (
+    RetriesExhaustedError,
+    RetryingClient,
+)
+
+# ------------------------------------------------------------ chaos modes
+
+
+def test_chaos_poisoned_cohort():
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_poisoned_cohort,
+    )
+
+    record = chaos_poisoned_cohort()
+    assert record.passed, record.detail
+    assert record.detail["poison_status"] == "failed"
+    assert record.detail["healthy_statuses"] == ["done", "done"]
+
+
+def test_chaos_truncated_checkpoint(tmp_path):
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_truncated_checkpoint,
+    )
+
+    record = chaos_truncated_checkpoint(workdir=str(tmp_path))
+    assert record.passed, record.detail
+    assert record.detail["fallback_warned"]
+    assert record.detail["objective_bitwise"]
+
+
+def test_chaos_broken_progress_callback():
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_broken_progress_callback,
+    )
+
+    record = chaos_broken_progress_callback()
+    assert record.passed, record.detail
+    assert record.detail["callback_invocations"] > 0
+
+
+def test_chaos_daemon_kill_restart():
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_daemon_kill_restart,
+    )
+
+    record = chaos_daemon_kill_restart()
+    assert record.passed, record.detail
+    assert record.detail["resubmit_cache_hit"] is True
+    assert record.detail["resubmit_compile_seconds"] == 0.0
+    assert record.detail["killed_request_after_restart"]["status"] == 404
+
+
+def test_chaos_suite_gates_and_metrics():
+    """The suite's gate block is what the golden corpus commits; the
+    injection gauge resets per run and carries one series per mode."""
+    from distributed_optimization_tpu.observability.metrics_registry import (
+        metrics_registry,
+    )
+    from distributed_optimization_tpu.scenarios.chaos import run_chaos_suite
+
+    suite = run_chaos_suite(
+        modes=("poisoned_cohort", "broken_progress_callback"),
+    )
+    assert suite["gates"] == {
+        "poisoned_cohort_graceful": True,
+        "broken_progress_callback_graceful": True,
+    }
+    gauge = metrics_registry().gauge("dopt_scenario_chaos_injections")
+    assert gauge.value(mode="poisoned_cohort") == 1
+    assert gauge.value(mode="broken_progress_callback") == 1
+    # Reset-per-run: a narrower suite replaces the series wholesale.
+    suite = run_chaos_suite(modes=("broken_progress_callback",))
+    assert gauge.value(mode="poisoned_cohort") == 0.0
+    assert gauge.value(mode="broken_progress_callback") == 1
+
+
+def test_chaos_unknown_mode_rejected():
+    from distributed_optimization_tpu.scenarios.chaos import run_chaos_suite
+
+    with pytest.raises(ValueError, match="unknown chaos mode"):
+        run_chaos_suite(modes=("drop_tables",))
+
+
+# ------------------------------------------- daemon socket timeout
+
+
+def _idle_daemon(socket_timeout_s: float):
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    daemon = ServingDaemon(
+        "127.0.0.1", 0,
+        service=SimulationService(ServingOptions(window_s=0.0)),
+        socket_timeout_s=socket_timeout_s,
+    )
+    daemon.start()
+    return daemon
+
+
+def test_daemon_drops_stalled_connection():
+    """A client that connects and never completes a request must be
+    dropped by the socket timeout instead of pinning a handler thread
+    forever (ISSUE-12 satellite)."""
+    daemon = _idle_daemon(socket_timeout_s=0.4)
+    try:
+        host, port = daemon.address
+        sock = socket.create_connection((host, port), timeout=10.0)
+        try:
+            # Send a partial request line and stall: the server's read
+            # loop must time out and close the connection — recv sees
+            # EOF within a couple of timeout periods.
+            sock.sendall(b"GET /v1/stat")
+            sock.settimeout(10.0)
+            t0 = time.perf_counter()
+            data = sock.recv(4096)
+            elapsed = time.perf_counter() - t0
+            assert data == b"", "server should close the stalled connection"
+            assert elapsed < 8.0
+        finally:
+            sock.close()
+        # The daemon is still healthy for well-behaved clients.
+        client = RetryingClient(daemon.url, max_retries=2)
+        code, st = client.status(timeout=10.0)
+        assert code == 200 and st["status"] == "serving"
+    finally:
+        daemon.stop()
+
+
+def test_daemon_timeout_disabled_keeps_connection_open():
+    """socket_timeout_s=0 preserves the historical no-timeout behavior
+    (explicit opt-out)."""
+    daemon = _idle_daemon(socket_timeout_s=0.0)
+    try:
+        host, port = daemon.address
+        sock = socket.create_connection((host, port), timeout=5.0)
+        try:
+            sock.sendall(b"GET /v1/stat")
+            sock.settimeout(1.5)
+            with pytest.raises(socket.timeout):
+                sock.recv(4096)  # server is (correctly) still waiting
+        finally:
+            sock.close()
+    finally:
+        daemon.stop()
+
+
+# ------------------------------------------------- retrying client
+
+
+class _FlakyHandler(BaseHTTPRequestHandler):
+    """Answers 429 (or 503) n_flaky times, then 200."""
+
+    def log_message(self, *a):
+        pass
+
+    def _respond(self):
+        srv = self.server
+        srv.calls += 1
+        if srv.calls <= srv.n_flaky:
+            body = json.dumps({"error": "queue_full"}).encode()
+            self.send_response(srv.flaky_status)
+        else:
+            body = json.dumps({"ok": True, "calls": srv.calls}).encode()
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_GET = do_POST = _respond
+
+
+def _flaky_server(n_flaky: int, status: int = 429):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    srv.calls = 0
+    srv.n_flaky = n_flaky
+    srv.flaky_status = status
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+@pytest.mark.parametrize("status", [429, 503])
+def test_client_retries_backpressure_then_succeeds(status):
+    srv = _flaky_server(2, status)
+    try:
+        sleeps = []
+        client = RetryingClient(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            max_retries=5, backoff_s=0.01, seed=0,
+            sleep=sleeps.append,
+        )
+        code, payload = client.status()
+        assert code == 200 and payload["ok"]
+        assert srv.calls == 3  # two rejections + the success
+        assert client.n_retries == 2
+        # Exponential backoff with jitter in [0.5, 1.0] of the base.
+        assert len(sleeps) == 2
+        assert 0.005 <= sleeps[0] <= 0.01
+        assert 0.01 <= sleeps[1] <= 0.02
+    finally:
+        srv.shutdown()
+
+
+def test_client_bounded_retries_then_raises():
+    srv = _flaky_server(100)
+    try:
+        client = RetryingClient(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            max_retries=3, backoff_s=0.001, seed=0, sleep=lambda s: None,
+        )
+        with pytest.raises(RetriesExhaustedError) as ei:
+            client.status()
+        assert ei.value.last_status == 429
+        assert srv.calls == 4  # initial try + 3 retries
+    finally:
+        srv.shutdown()
+
+
+def test_client_retries_connection_refused_until_server_appears():
+    """The kill/restart window: connection failures retry with backoff
+    until the (re)started daemon answers."""
+    placeholder = socket.socket()
+    placeholder.bind(("127.0.0.1", 0))
+    port = placeholder.getsockname()[1]
+    placeholder.close()  # now nothing listens on `port`
+
+    srv_box = {}
+
+    def boot_later():
+        time.sleep(0.3)
+        srv = ThreadingHTTPServer(("127.0.0.1", port), _FlakyHandler)
+        srv.calls = 0
+        srv.n_flaky = 0
+        srv.flaky_status = 429
+        srv_box["srv"] = srv
+        srv.serve_forever()
+
+    threading.Thread(target=boot_later, daemon=True).start()
+    try:
+        client = RetryingClient(
+            f"http://127.0.0.1:{port}", max_retries=10,
+            backoff_s=0.1, backoff_cap_s=0.2, seed=0,
+        )
+        code, payload = client.status(timeout=5.0)
+        assert code == 200 and payload["ok"]
+        assert client.n_retries >= 1
+    finally:
+        srv = srv_box.get("srv")
+        if srv is not None:
+            srv.shutdown()
+
+
+def test_client_metrics_text_does_not_retry_structured_errors():
+    """HTTPError subclasses URLError/OSError; metrics_text must classify
+    it FIRST — a 404 (no /metrics on this stub) surfaces immediately,
+    never burning the retry budget."""
+    import urllib.error
+
+    class _NoMetrics(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b'{"error": "unknown_endpoint"}'
+            self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _NoMetrics)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        client = RetryingClient(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            max_retries=5, backoff_s=0.001, seed=0, sleep=lambda s: None,
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            client.metrics_text(timeout=5.0)
+        assert client.n_retries == 0
+    finally:
+        srv.shutdown()
+
+
+def test_client_does_not_retry_structured_errors():
+    """400/404 are answers, not transport faults: returned once with the
+    daemon's structured body, never retried."""
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    daemon = ServingDaemon(
+        "127.0.0.1", 0,
+        service=SimulationService(ServingOptions(window_s=0.0)),
+    )
+    daemon.start()
+    try:
+        client = RetryingClient(daemon.url, max_retries=5, seed=0)
+        code, payload = client.result("req-999999", timeout=0.1)
+        assert code == 404 and payload["error"] == "unknown_request"
+        assert client.n_retries == 0
+        code, payload = client.submit({"topology": "moebius"})
+        assert code == 400 and payload["error"] == "invalid_config"
+        assert client.n_retries == 0
+    finally:
+        daemon.stop()

@@ -384,6 +384,9 @@ PERF_ARTIFACT_KEYS = {
     "robust_scale.json": {
         "crossover_n64", "device", "headline_n256_ring", "note", "protocol"},
     "scaling.json": {"config", "device", "rows"},
+    "scenarios.json": {
+        "agreement", "chaos", "checkpoint", "device", "gates", "matrix",
+        "note", "platform", "protocol", "spec"},
     "serving.json": {
         "device", "platform", "protocol", "note", "workload", "latency",
         "throughput", "parity", "gates"},
