@@ -7,7 +7,7 @@ PY ?= python
 	bench-byzantine bench-churn \
 	bench-robust-scale bench-sweep bench-compute bench-telemetry \
 	bench-fused bench-serving bench-federated bench-async \
-	bench-observatory bench-mesh bench-scenarios
+	bench-observatory bench-mesh bench-scenarios bench-monitors
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -28,8 +28,8 @@ smoke:
 		tests/test_telemetry.py tests/test_serving.py \
 		tests/test_federated.py tests/test_async.py \
 		tests/test_matrix_free_faults.py tests/test_observatory.py \
-		tests/test_worker_mesh.py tests/test_scenarios.py \
-		tests/test_scenario_chaos.py
+		tests/test_monitors.py tests/test_worker_mesh.py \
+		tests/test_scenarios.py tests/test_scenario_chaos.py
 	$(MAKE) observatory-smoke
 	$(MAKE) scenarios-smoke
 
@@ -133,6 +133,13 @@ bench-serving:
 # bitwise gate, async-path cell, /metrics scrape p95 under load).
 bench-observatory:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_observatory.py
+
+# Regenerate the anomaly-sentinel evidence (docs/perf/monitors.json:
+# ≤5% monitor overhead on the sequential + async paths, monitors-on
+# bitwise, planted f>b divergence onset within 2 eval windows, early
+# halt with attacker-naming incident — all gated).
+bench-monitors:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_monitors.py
 
 # Regenerate the scenario-matrix golden corpus (docs/perf/scenarios.json:
 # validity-table agreement over a seeded 700-cell sample, the

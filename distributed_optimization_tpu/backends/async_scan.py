@@ -190,15 +190,26 @@ def run_async(
     executable_cache=None,
     progress_cb=None,
     progress_every: int = 1,
+    monitors=None,
 ) -> BackendRunResult:
     """Run one asynchronous experiment (``config.execution == 'async'``).
 
-    ``progress_cb``/``progress_every`` (ISSUE-10): when set, the outer
-    scan over eval chunks runs as a host-driven loop over the SAME
-    compiled chunk body (one executable serves every chunk — the event
-    arrays are traced inputs), emitting one ``ProgressEvent`` per
-    ``progress_every`` eval chunks with live staleness quantiles over the
-    executed window. ``None`` changes nothing (one fused program).
+    ``progress_cb``/``progress_every`` (ISSUE-10; host-loop granularity
+    fixed in ISSUE-13): when set, the run executes as SEGMENTS of
+    ``progress_every`` eval chunks, each segment one compiled call of the
+    SAME outer-scan body the fused program runs (the event arrays are
+    traced inputs, so one executable serves every same-size segment),
+    with one ``ProgressEvent`` per boundary carrying live staleness
+    quantiles over the executed window. The host syncs once per
+    HEARTBEAT, not once per eval chunk — the original per-chunk loop
+    measured 12.3% overhead on the bench container
+    (docs/perf/observatory.json pre-fix), the segmented form is gated at
+    ≤5%. ``None`` changes nothing (one fused program).
+
+    ``monitors`` (ISSUE-13): a ``MonitorBank`` joining the heartbeat
+    chain (staleness blowup, divergence, non-finite sentinels); under
+    ``halt_on='fatal'`` the run stops at the next segment boundary with
+    the executed prefix as a partial result.
 
     ``batch_schedule [E_total, b]`` injects fixed per-EVENT batch indices
     into the firing worker's shard (the oracle-equivalence convention —
@@ -221,6 +232,7 @@ def run_async(
             state0=state0, start_event=start_event, n_events=n_events,
             executable_cache=executable_cache,
             progress_cb=progress_cb, progress_every=progress_every,
+            monitors=monitors,
         )
 
 
@@ -239,6 +251,7 @@ def _run_async(
     executable_cache,
     progress_cb=None,
     progress_every: int = 1,
+    monitors=None,
 ) -> BackendRunResult:
     if progress_every < 1:
         raise ValueError(
@@ -399,45 +412,67 @@ def _run_async(
         return jax.lax.scan(make_chunk_body(data), state, data["ev"])
 
     exec_cache = resolve_cache(executable_cache)
-    if progress_cb is not None:
-        # Progress streaming (ISSUE-10): host-driven loop over the SAME
-        # compiled chunk body — the event arrays are traced inputs, so ONE
-        # executable serves every chunk; a Python loop feeding carries
-        # executes the identical per-chunk computation the fused outer
-        # scan would (bitwise, asserted in tests/test_observatory.py).
-        emit = _async_progress_emitter(
-            config, progress_cb, timeline, start_event
+    n_done_evals = n_evals
+    if progress_cb is not None or monitors is not None:
+        # Progress streaming (ISSUE-10; segment-fused in ISSUE-13): the
+        # run executes as SEGMENTS of ``progress_every`` eval chunks,
+        # each segment one compiled call of the SAME outer scan over its
+        # chunk rows — the event arrays are traced inputs, so one
+        # executable serves every same-size segment, and the per-segment
+        # scans compose to exactly the fused program's computation
+        # (bitwise, asserted in tests/test_observatory.py /
+        # tests/test_monitors.py). The host syncs once per heartbeat
+        # instead of once per chunk — the ISSUE-10 per-chunk loop's
+        # measured 12.3% overhead was pure dispatch latency this buys
+        # back (docs/perf/observatory.json).
+        from distributed_optimization_tpu.backends.jax_backend import (
+            _fanout_progress,
         )
 
-        def chunk_once(state, data):
-            return make_chunk_body(data)(state, data["ev"])
+        cb = _fanout_progress(progress_cb, monitors)
+        emit = _async_progress_emitter(config, cb, timeline, start_event)
+        halt_check = (
+            monitors.should_halt
+            if monitors is not None and monitors.halt_on != "never"
+            else None
+        )
+        seg_chunks = min(max(int(progress_every), 1), n_evals)
+        sizes = {seg_chunks}
+        if n_evals % seg_chunks:
+            sizes.add(n_evals % seg_chunks)
 
-        cache_key = cached = None
-        if exec_cache is not None:
-            cache_key = sequential_cache_key(
-                config, f_opt, device_data,
-                schedule_signature=(
-                    "async-progress", events_per_eval, sched_sig,
-                ),
-                collect_metrics=collect_metrics,
-            )
-            cached = exec_cache.get(cache_key)
-        data_c = dict(data_args)
-        data_c["ev"] = {k: v[0] for k, v in ev_chunks.items()}
-        if cached is not None:
-            compiled = cached.executable
-            compile_seconds = 0.0
-        else:
+        def seg_scan(state, data):
+            return jax.lax.scan(make_chunk_body(data), state, data["ev"])
+
+        compiled_by_size = {}
+        compile_seconds = 0.0
+        for size in sorted(sizes):
+            cache_key = cached = None
+            if exec_cache is not None:
+                cache_key = sequential_cache_key(
+                    config, f_opt, device_data,
+                    schedule_signature=(
+                        "async-seg", events_per_eval, int(size), sched_sig,
+                    ),
+                    collect_metrics=collect_metrics,
+                )
+                cached = exec_cache.get(cache_key)
+            if cached is not None:
+                compiled_by_size[size] = cached.executable
+                continue
+            data_c = dict(data_args)
+            data_c["ev"] = {k: v[:size] for k, v in ev_chunks.items()}
             t0c = time.perf_counter()
             with jax.default_matmul_precision(config.matmul_precision):
-                lowered = jax.jit(chunk_once).lower(st0, data_c)
+                lowered = jax.jit(seg_scan).lower(st0, data_c)
                 cost = cost_from_lowered(lowered)
-                compiled = lowered.compile()
+                compiled_by_size[size] = lowered.compile()
             cold_seconds = time.perf_counter() - t0c
-            compile_seconds = cold_seconds if measure_compile else 0.0
+            if measure_compile:
+                compile_seconds += cold_seconds
             if exec_cache is not None:
                 exec_cache.put(
-                    cache_key, compiled, cost=cost,
+                    cache_key, compiled_by_size[size], cost=cost,
                     compile_seconds=cold_seconds,
                 )
 
@@ -445,31 +480,47 @@ def _run_async(
         state = st0
         gap_list: list[float] = []
         cons_list: list[float] = []
-        last_emit_chunk = 0
-        for c in range(n_evals):
+        done = 0
+        while done < n_evals:
+            this_chunks = min(seg_chunks, n_evals - done)
             data_c = dict(data_args)
-            data_c["ev"] = {k: v[c] for k, v in ev_chunks.items()}
-            state, out = compiled(state, data_c)
+            data_c["ev"] = {
+                k: v[done:done + this_chunks] for k, v in ev_chunks.items()
+            }
+            state, outs = compiled_by_size[this_chunks](state, data_c)
             jax.block_until_ready(state)
-            if "gap" in out:
-                gap_list.append(float(out["gap"]))
-            if "cons" in out:
-                cons_list.append(float(out["cons"]))
-            if (c + 1) % progress_every == 0 or c + 1 == n_evals:
-                emit(
-                    (c + 1) * events_per_eval,
-                    start_round + (c + 1) * config.eval_every,
-                    gap_list[-1] if gap_list else None,
-                    cons_list[-1] if cons_list else None,
-                    time.perf_counter() - t1,
-                    (c + 1 - last_emit_chunk) * events_per_eval,
+            if "gap" in outs:
+                gap_list.extend(
+                    float(g) for g in np.asarray(outs["gap"])
                 )
-                last_emit_chunk = c + 1
+            if "cons" in outs:
+                cons_list.extend(
+                    float(c) for c in np.asarray(outs["cons"])
+                )
+            done += this_chunks
+            emit(
+                done * events_per_eval,
+                start_round + done * config.eval_every,
+                gap_list[-1] if gap_list else None,
+                cons_list[-1] if cons_list else None,
+                time.perf_counter() - t1,
+                this_chunks * events_per_eval,
+            )
+            if halt_check is not None and halt_check():
+                # Early-halt policy (ISSUE-13): stop at this segment
+                # boundary; the executed event prefix is the fused
+                # program's prefix (the continuation contract).
+                break
         final_state = state
         run_seconds = time.perf_counter() - t1
+        n_done_evals = done
+        if monitors is not None and done < n_evals:
+            monitors.note_halt(
+                start_round + done * config.eval_every
+            )
         gap_hist = (
             np.asarray(gap_list, dtype=np.float64)
-            if gap_list else np.full(n_evals, np.nan)
+            if gap_list else np.full(n_done_evals, np.nan)
         )
         cons_hist = (
             np.asarray(cons_list, dtype=np.float64) if cons_list else None
@@ -520,26 +571,30 @@ def _run_async(
         )
     # Comms accounting: every matched event moves one pairwise exchange —
     # both models cross the wire, 2·d floats (a solo event moves none).
-    matched_slice = int(np.sum(timeline.matched()[sl]))
+    # Halted runs bill only the executed event prefix.
+    done_events = n_done_evals * events_per_eval
+    done_rounds = done_events // n
+    sl_done = slice(start_event, start_event + done_events)
+    matched_slice = int(np.sum(timeline.matched()[sl_done]))
     total_floats = 2.0 * d_model * matched_slice
 
     history = RunHistory(
         objective=gap_hist,
         consensus_error=cons_hist,
         time=np.linspace(
-            run_seconds / max(n_evals, 1), run_seconds, n_evals
+            run_seconds / max(n_done_evals, 1), run_seconds, n_done_evals
         ),
         time_measured=False,
         # Round-based iteration numbering (N events per round), so
         # iters-to-ε stays comparable with the synchronous paths.
         eval_iterations=np.arange(
             start_round + config.eval_every,
-            start_round + rounds_slice + 1,
+            start_round + done_rounds + 1,
             config.eval_every,
         ),
         total_floats_transmitted=total_floats,
         iters_per_second=(
-            rounds_slice / run_seconds if run_seconds > 0 else float("nan")
+            done_rounds / run_seconds if run_seconds > 0 else float("nan")
         ),
         compile_seconds=compile_seconds,
         spectral_gap=topo.spectral_gap,

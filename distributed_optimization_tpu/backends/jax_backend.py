@@ -132,6 +132,35 @@ def _make_eta_fn(config, eta0=None):
     return lambda t: jnp.asarray(eta0)
 
 
+def _fanout_progress(progress_cb, monitors):
+    """Compose the user progress callback with a ``MonitorBank`` observer
+    (ISSUE-13): each consumer is shielded individually, so a broken user
+    callback cannot starve the monitors of heartbeats (or vice versa).
+    Returns None when both are absent — progress off stays the pre-PR
+    code path."""
+    cbs = []
+    if progress_cb is not None:
+        cbs.append(progress_cb)
+    if monitors is not None:
+        cbs.append(monitors.observe)
+    if not cbs:
+        return None
+    if len(cbs) == 1:
+        return cbs[0]
+    from distributed_optimization_tpu.log import get_logger
+
+    log = get_logger("progress")
+
+    def fan(ev):
+        for cb in cbs:
+            try:
+                cb(ev)
+            except Exception:  # observability never kills the run
+                log.exception("progress consumer failed; continuing run")
+
+    return fan
+
+
 def _progress_emitter(
     config, progress_cb, *, t0: int = 0, kind="chunk", with_bhat=True,
 ):
@@ -147,6 +176,13 @@ def _progress_emitter(
     realizes R DISTINCT fault timelines (one per replica seed), so a
     single heartbeat has no B̂ that is true for the cohort — emitting the
     base config's would misattribute replica 0's realization to everyone.
+
+    When the live-B̂ probe is ACTIVE but reports None — the executed
+    prefix's union graph is disconnected, so no finite B exists — the
+    event carries ``extra={"bhat_disconnected": True}``: a bare
+    ``bhat=None`` is ambiguous (it also means "not applicable"), and the
+    connectivity-loss monitor must be able to tell assumption violation
+    from absence (ISSUE-13).
     """
     if progress_cb is None:
         return None
@@ -168,6 +204,14 @@ def _progress_emitter(
         cons = float(cons_list[-1]) if cons_list is not None and len(
             cons_list
         ) else None
+        bhat = None
+        if live_bhat is not None:
+            bhat = live_bhat(iteration)
+            if bhat is None:
+                extra = dict(extra)
+                extra["extra"] = {
+                    **(extra.get("extra") or {}), "bhat_disconnected": True,
+                }
         ev = ProgressEvent(
             kind=kind,
             iteration=int(iteration),
@@ -175,7 +219,7 @@ def _progress_emitter(
             wall_seconds=float(elapsed),
             gap=gap,
             consensus=cons,
-            bhat=live_bhat(iteration) if live_bhat is not None else None,
+            bhat=bhat,
             **extra,
         )
         counter.inc()
@@ -792,7 +836,7 @@ def _bind_byzantine(config, algo, topo, faulty, mix_op, *, clip_tau=None,
 
 def _run_chunked(
     chunk, state0, data_args, checkpoint, mesh, config, n_evals,
-    measure_compile, progress_hook=None, progress_every=1,
+    measure_compile, progress_hook=None, progress_every=1, halt_check=None,
 ):
     """Host-driven chunk loop: measured per-eval timestamps, optional orbax
     checkpointing (``checkpoint=None`` runs the loop purely for timing).
@@ -864,6 +908,7 @@ def _run_chunked(
     time_offset = time_list[-1] if time_list else 0.0
     t1 = time.perf_counter()
     save_seconds = 0.0  # cumulative orbax-save time, excluded from stamps
+    done = start_chunk
     for c in range(start_chunk, n_evals):
         ts = _replicate(
             mesh,
@@ -904,13 +949,19 @@ def _run_chunked(
                 gap_list, cons_list, floats_list, time_list,
             )
             save_seconds += time.perf_counter() - t_save
+        if halt_check is not None and halt_check():
+            # Early-halt policy (ISSUE-13): a fatal anomaly stops the run
+            # at this eval-chunk boundary — the executed prefix is the
+            # full run's prefix (same compiled chunk, same carries), the
+            # remaining chunks just never execute.
+            break
     run_seconds = time.perf_counter() - t1 - save_seconds
 
     gap_hist = np.asarray(gap_list, dtype=np.float64)
     cons_hist = np.asarray(cons_list, dtype=np.float64) if cons_list else None
     time_hist = np.asarray(time_list, dtype=np.float64)
     realized_floats = float(np.sum(floats_list)) if floats_list else None
-    executed_iters = (n_evals - start_chunk) * eval_every
+    executed_iters = (done - start_chunk) * eval_every
     trace = (
         {k: np.stack(v) for k, v in trace_lists.items()}
         if trace_lists else None
@@ -922,7 +973,7 @@ def _run_chunked(
 def _run_segmented_fused(
     make_seg_scan, harvest, state0, data_args, checkpoint, mesh, config,
     n_evals, measure_compile, *, progress_hook=None, progress_every=1,
-    exec_cache=None, cache_key_fn=None,
+    exec_cache=None, cache_key_fn=None, halt_check=None,
 ):
     """Segmented execution of the flat fused scan (round 4 — VERDICT r3
     item 5; generalized for ISSUE-10 progress streaming).
@@ -1095,13 +1146,19 @@ def _run_segmented_fused(
                 gap_list, cons_list, floats_list, time_list,
             )
             save_seconds += time.perf_counter() - t_save
+        if halt_check is not None and halt_check():
+            # Early-halt policy (ISSUE-13): a fatal anomaly fired on this
+            # segment's heartbeat — stop at the boundary. The executed
+            # prefix is the one-shot program's prefix (the continuation
+            # contract); the remaining segments never execute.
+            break
     run_seconds = time.perf_counter() - t1 - save_seconds
 
     gap_hist = np.asarray(gap_list, dtype=np.float64) if gap_list else None
     cons_hist = np.asarray(cons_list, dtype=np.float64) if cons_list else None
     time_hist = np.asarray(time_list, dtype=np.float64)
     realized_floats = float(np.sum(floats_list)) if floats_list else None
-    executed_iters = remaining * eval_every
+    executed_iters = (done - start_chunk) * eval_every
     trace = (
         {k: np.concatenate(v, axis=0) for k, v in trace_lists.items()}
         if trace_lists else None
@@ -1128,8 +1185,21 @@ def run(
     executable_cache=None,
     progress_cb=None,
     progress_every: int = 1,
+    monitors=None,
 ) -> BackendRunResult:
     """Run one experiment on the JAX backend; returns histories + final models.
+
+    ``monitors`` (ISSUE-13 anomaly sentinel): an
+    ``observability.monitors.MonitorBank`` observing the run's heartbeats
+    online. With a bank installed the run executes through the SAME
+    segmented progress machinery as ``progress_cb`` (off, and on with
+    nothing firing, are bitwise the one-shot program — the progress
+    contract), detectors fire structured anomalies into the bank, and
+    under ``halt_on='fatal'`` a fatal anomaly stops the run at the next
+    chunk boundary with the executed prefix returned as a partial
+    result (``monitors.halted_at`` records where). Trace-derived
+    detectors are fed the flight-recorder buffers after the run when
+    ``config.telemetry`` is on.
 
     ``progress_cb`` (ISSUE-10 live observatory): a host callback receiving
     one ``observability.progress.ProgressEvent`` every ``progress_every``
@@ -1209,6 +1279,7 @@ def run(
             measure_compile=measure_compile, return_state=return_state,
             executable_cache=executable_cache,
             progress_cb=progress_cb, progress_every=progress_every,
+            monitors=monitors,
         )
     with x64_scope(config):
         return _run(
@@ -1221,6 +1292,7 @@ def run(
             eval_hoist_limit=eval_hoist_limit,
             executable_cache=executable_cache,
             progress_cb=progress_cb, progress_every=progress_every,
+            monitors=monitors,
         )
 
 
@@ -1298,6 +1370,7 @@ def _run(
     executable_cache=None,
     progress_cb=None,
     progress_every: int = 1,
+    monitors=None,
 ) -> BackendRunResult:
     """Backend implementation (see ``run``).
 
@@ -1321,7 +1394,16 @@ def _run(
         raise ValueError(
             f"progress_every must be >= 1 eval-chunks, got {progress_every}"
         )
-    progress_emit = _progress_emitter(config, progress_cb)
+    # Monitors ride the progress machinery (ISSUE-13): the bank's observe
+    # joins the callback chain, and under halt_on='fatal' the segmented
+    # loops consult should_halt() at every chunk boundary.
+    progress_emit = _progress_emitter(
+        config, _fanout_progress(progress_cb, monitors)
+    )
+    halt_check = (
+        monitors.should_halt
+        if monitors is not None and monitors.halt_on != "never" else None
+    )
     algo = get_algorithm(config.algorithm)
     problem = get_problem(
         config.problem_type, huber_delta=config.huber_delta,
@@ -1965,10 +2047,11 @@ def _run(
                     progress_hook=progress_emit,
                     progress_every=progress_every,
                     exec_cache=seg_cache, cache_key_fn=cache_key_fn,
+                    halt_check=halt_check,
                 )
             )
             if gap_hist is None:
-                gap_hist = np.full(n_evals, np.nan)
+                gap_hist = np.full(len(time_hist), np.nan)
         # Per-eval wall-clock is interpolated on both fused paths (within
         # segments, for the checkpointed one) — time_measured stays False.
         time_measured = False
@@ -1981,17 +2064,37 @@ def _run(
             _run_chunked(
                 chunk_fn, state0, data_args, checkpoint, mesh, config,
                 n_evals, measure_compile, progress_hook=progress_emit,
-                progress_every=progress_every,
+                progress_every=progress_every, halt_check=halt_check,
             )
         )
         time_measured = True
         if not collect_metrics:
-            gap_hist = np.full(n_evals, np.nan)
+            gap_hist = np.full(len(time_hist), np.nan)
         if not track_consensus:
             cons_hist = None
 
+    # Early-halt bookkeeping (ISSUE-13): a loop that stopped before the
+    # horizon left fewer per-eval rows than n_evals. The histories stay
+    # honestly partial (their eval axis names the executed prefix), the
+    # bank records where, and the analytic floats accounting covers only
+    # the executed iterations — a halted run must not bill the horizon.
+    n_done_evals = len(time_hist)
+    halted = monitors is not None and n_done_evals < n_evals
+    if halted:
+        monitors.note_halt(n_done_evals * eval_every)
+    if monitors is not None and trace is not None:
+        # The iteration axis starts at eval_every unconditionally: trace
+        # buffers exist only under config.telemetry, which is rejected
+        # with checkpointing above — a trace can never belong to a
+        # resumed run whose rows would need a start-chunk offset.
+        monitors.scan_trace(
+            trace,
+            np.arange(eval_every, T + 1, eval_every)[:n_done_evals],
+        )
+
     total_floats = (
-        realized_floats if realized_floats is not None else floats_per_iter * T
+        realized_floats if realized_floats is not None
+        else floats_per_iter * (n_done_evals * eval_every if halted else T)
     )
     final_models = _fetch_to_host(final_state["x"]).astype(np.float64)
     # The reported model under attack is the HONEST average — Byzantine
@@ -2007,7 +2110,10 @@ def _run(
         consensus_error=cons_hist,
         time=time_hist,
         time_measured=time_measured,
-        eval_iterations=np.arange(eval_every, T + 1, eval_every),
+        # Truncated to the executed prefix when the run halted early.
+        eval_iterations=np.arange(eval_every, T + 1, eval_every)[
+            :n_done_evals
+        ],
         total_floats_transmitted=total_floats,
         # Throughput counts only iterations executed in THIS process, so a
         # resumed run doesn't claim credit for checkpointed progress.
@@ -2157,8 +2263,17 @@ def run_batch(
     executable_cache=None,
     progress_cb=None,
     progress_every: int = 1,
+    monitors=None,
 ) -> BatchRunResult:
     """Run R replicas of ``config`` as one vmapped XLA program.
+
+    ``monitors`` (ISSUE-13): a ``MonitorBank`` observing the cohort
+    heartbeats (which carry per-replica gaps — the divergence detector
+    judges the WORST replica, so one sick replica cannot hide behind the
+    cohort mean); under ``halt_on='fatal'`` the whole batch stops at the
+    next segment boundary (the replica axis is one compiled program — it
+    cannot halt per replica). Rides the same segmented machinery as
+    ``progress_cb``; trajectories with nothing firing stay bitwise.
 
     ``progress_cb``/``progress_every`` (ISSUE-10): when set, the batched
     program executes as segments of ``progress_every`` eval-chunks (the
@@ -2201,6 +2316,7 @@ def run_batch(
             measure_compile=measure_compile, state0=state0, t0=t0,
             executable_cache=executable_cache,
             progress_cb=progress_cb, progress_every=progress_every,
+            monitors=monitors,
         )
 
 
@@ -2218,6 +2334,7 @@ def _run_batch(
     executable_cache=None,
     progress_cb=None,
     progress_every: int = 1,
+    monitors=None,
 ) -> BatchRunResult:
     from distributed_optimization_tpu.config import SWEEPABLE_FIELDS
     from distributed_optimization_tpu.parallel.adversary import (
@@ -2226,8 +2343,8 @@ def _run_batch(
     )
     from distributed_optimization_tpu.parallel.faults import (
         FaultTimeline,
-        build_fault_timeline,
         stack_fault_timelines,
+        timeline_for_config,
     )
 
     # --- resolve and validate the replica axis -------------------------
@@ -2404,16 +2521,11 @@ def _run_batch(
         ])
     stacked_tl = None
     if algo.is_decentralized and use_timeline:
+        # One canonical config -> timeline mapping (parallel/faults.py):
+        # the host-side consumers (realized B̂, live heartbeats, incident
+        # forensics) rebuild bitwise these realizations from it.
         stacked_tl = stack_fault_timelines([
-            build_fault_timeline(
-                topo, horizon, c.seed,
-                edge_drop_prob=c.edge_drop_prob,
-                burst_len=c.burst_len if c.burst_len >= 1.0 else 1.0,
-                straggler_prob=0.0 if c.mttf > 0.0 else c.straggler_prob,
-                mttf=c.mttf, mttr=c.mttr,
-                participation_rate=c.participation_rate,
-            )
-            for c in rep_cfgs
+            timeline_for_config(c, topo, horizon) for c in rep_cfgs
         ])
         if stacked_tl.edge_up is not None:
             rp["tl_edge_up"] = jnp.asarray(stacked_tl.edge_up)
@@ -2635,7 +2747,8 @@ def _run_batch(
             )
         return compiled, cost, cold_seconds
 
-    if progress_cb is None:
+    n_done_evals = n_evals
+    if progress_cb is None and monitors is None:
         compiled, cost, cold_seconds = _compile_trips(n_trips, None)
         compile_seconds = cold_seconds if measure_compile else 0.0
         t_r = time.perf_counter()
@@ -2654,7 +2767,13 @@ def _run_batch(
                 f"{progress_every}"
             )
         emit = _progress_emitter(
-            config, progress_cb, t0=t0, with_bhat=False,
+            config, _fanout_progress(progress_cb, monitors),
+            t0=t0, with_bhat=False,
+        )
+        halt_check = (
+            monitors.should_halt
+            if monitors is not None and monitors.halt_on != "never"
+            else None
         )
         seg_evals = min(max(int(progress_every), 1), max(n_evals, 1))
         sizes = {min(seg_evals, n_evals)}
@@ -2705,13 +2824,22 @@ def _run_batch(
                     done, gap_means, cons_means,
                     time.perf_counter() - t_r, **extra,
                 )
+            if halt_check is not None and halt_check():
+                # Early-halt policy (ISSUE-13): the whole cohort stops at
+                # this segment boundary — one compiled program, one halt.
+                break
         final_states = state_R
         ys = jax.tree.map(
             lambda *vs: jnp.concatenate(vs, axis=1), *ys_segments
         ) if len(ys_segments) > 1 else ys_segments[0]
         run_seconds = time.perf_counter() - t_r
+        n_done_evals = done
+        if monitors is not None and done < n_evals:
+            monitors.note_halt(t0 + done * eval_every)
 
     # --- harvest [R, n_trips, ...] scan outputs to per-eval rows --------
+    # ``n_done_evals`` < n_evals only when the early-halt policy stopped
+    # the batch: the histories then honestly cover the executed prefix.
     sel = slice(trips_per_eval - 1, None, trips_per_eval)
     gap = (
         np.asarray(ys["gap"], dtype=np.float64)[:, sel]
@@ -2723,7 +2851,7 @@ def _run_batch(
     )
     floats = (
         np.asarray(ys["floats"], dtype=np.float64)
-        .reshape(R, n_evals, trips_per_eval).sum(axis=2)
+        .reshape(R, n_done_evals, trips_per_eval).sum(axis=2)
         if "floats" in ys else None
     )
     # Trace-buffer rows select like the gap (eval-boundary trips), with the
@@ -2732,27 +2860,32 @@ def _run_batch(
         {k: np.asarray(v)[:, sel] for k, v in ys["trace"].items()}
         if "trace" in ys else None
     )
-    objective = gap if gap is not None else np.full((R, n_evals), np.nan)
+    objective = (
+        gap if gap is not None else np.full((R, n_done_evals), np.nan)
+    )
 
     final_states_np = {
         k: np.asarray(v) for k, v in final_states.items()
     }
     final_models = final_states_np["x"].astype(np.float64)  # [R, N, d]
+    executed_T = n_done_evals * eval_every
     aggregate_ips = (
-        R * T / run_seconds if run_seconds > 0 else float("nan")
+        R * executed_T / run_seconds if run_seconds > 0 else float("nan")
     )
     time_hist = np.linspace(
-        run_seconds / max(n_evals, 1), run_seconds, n_evals
+        run_seconds / max(n_done_evals, 1), run_seconds, n_done_evals
     )
     eval_iterations = np.arange(
         t0 + eval_every, t0 + T + 1, eval_every
-    )
+    )[:n_done_evals]
 
     results = []
     for r in range(R):
         total_floats = (
             float(floats[r].sum()) if floats is not None
-            else floats_per_iter * T
+            else floats_per_iter * (
+                executed_T if n_done_evals < n_evals else T
+            )
         )
         history = RunHistory(
             objective=objective[r],

@@ -447,6 +447,37 @@ def build_parser() -> argparse.ArgumentParser:
                       help="heartbeat cadence in eval-chunks (K x "
                            "eval_every iterations per heartbeat; "
                            "default 1)")
+    diag.add_argument("--monitors", action="store_true",
+                      help="watch the run with the anomaly sentinel "
+                           "(docs/OBSERVABILITY.md 'Monitors & "
+                           "incidents'): online detectors for "
+                           "divergence, consensus stall, non-finite "
+                           "state, realized-B-hat connectivity loss, "
+                           "async staleness blowup, and robust-"
+                           "screening saturation consume the run's "
+                           "heartbeats; firings are reported and can "
+                           "be written as incident bundles. Rides the "
+                           "segmented progress machinery (jax backend, "
+                           "tp=1); trajectories stay bitwise when "
+                           "nothing fires")
+    diag.add_argument("--halt-on", choices=("never", "fatal"),
+                      default="never",
+                      help="early-halt policy (implies --monitors): "
+                           "'fatal' stops the run at the next chunk "
+                           "boundary after a fatal anomaly "
+                           "(divergence, non-finite state, realized "
+                           "disconnection) and reports the executed "
+                           "prefix as a partial result; 'never' "
+                           "(default) only records")
+    diag.add_argument("--incidents-out", metavar="PATH", default=None,
+                      help="write anomaly incident bundles (config + "
+                           "structural hash, evidence window, fault/"
+                           "attack context around the onset) as JSONL "
+                           "to PATH (implies --monitors; default with "
+                           "--telemetry OUT: OUT's sibling "
+                           "'<OUT>.incidents.jsonl' when something "
+                           "fired). Browse with 'observatory "
+                           "incidents'")
     diag.add_argument("--trace-out", metavar="PATH", default=None,
                       help="write the span tracer's Chrome trace-event "
                            "JSON (data_gen/oracle + per-run compile/run "
@@ -676,6 +707,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
             run_kwargs["progress_cb"] = _print_progress
             run_kwargs["progress_every"] = args.progress_every
+    want_monitors = (
+        args.monitors or args.halt_on != "never"
+        or args.incidents_out is not None
+    )
+    if want_monitors:
+        if args.backend != "jax" or args.tp > 1:
+            # Like --progress: monitors consume the jax backend's
+            # segmented heartbeats — warn and run unwatched rather than
+            # failing a script that toggles backends.
+            _log.warning(
+                "--monitors/--halt-on ride the jax backend's chunked "
+                "execution (tp=1); backend=%s tp=%d runs unwatched",
+                args.backend, args.tp,
+            )
+        else:
+            from distributed_optimization_tpu.observability.monitors import (
+                MonitorBank,
+            )
+
+            # A factory, not a bank: detectors latch per run, so every
+            # run of a suite/matrix gets a fresh bank (the Simulator
+            # resolves callables per run).
+            run_kwargs["monitors"] = (
+                lambda cfg: MonitorBank(cfg, halt_on=args.halt_on)
+            )
     if args.measure_time is not None:
         if args.backend == "jax":
             run_kwargs["measure_timestamps"] = args.measure_time
@@ -735,6 +791,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         _log.info("results saved to %s", args.json)
     if args.telemetry:
         sim.write_telemetry(args.telemetry)
+    if want_monitors and args.backend == "jax" and args.tp <= 1:
+        fired = any(
+            rec.monitors is not None and rec.monitors.anomalies
+            for rec in sim.records
+        )
+        incidents_out = args.incidents_out
+        if incidents_out is None and args.telemetry and fired:
+            # Incident bundles ride next to the RunTrace manifests by
+            # default (the observatory convention: one directory, one
+            # story).
+            from distributed_optimization_tpu.observability.monitors import (
+                incidents_path_for,
+            )
+
+            incidents_out = str(incidents_path_for(args.telemetry))
+        if incidents_out is not None:
+            sim.write_incidents(incidents_out)
+        elif fired:
+            _log.warning(
+                "anomalies fired but no --incidents-out/--telemetry "
+                "path was given; forensic bundles were not persisted"
+            )
     if args.trace_out:
         sim.write_chrome_trace(args.trace_out)
     if args.metrics_out:

@@ -184,6 +184,106 @@ def build_index(root, **filters) -> list[RunRecord]:
     return records
 
 
+# --------------------------------------------------------------- incidents
+
+
+@dataclasses.dataclass
+class IncidentRecord:
+    """One indexed anomaly-sentinel incident bundle (ISSUE-13;
+    ``observability/monitors.py::build_incident``), flattened for the
+    ``incidents`` subcommand and the ``list --with-incidents`` join."""
+
+    path: str
+    line: Optional[int]
+    label: str
+    detector: str
+    severity: str
+    onset_iteration: Optional[int]
+    message: str
+    config_hash: Optional[str]
+    structural_hash: Optional[str]
+    algorithm: Optional[str]
+
+    def row(self) -> str:
+        onset = (
+            str(self.onset_iteration)
+            if self.onset_iteration is not None else "—"
+        )
+        return (
+            f"{self.label[:28]:<30}{self.detector:<22}{self.severity:<8}"
+            f"{onset:>8}  {(self.config_hash or '—')[:12]:<14}"
+            f"{(self.algorithm or '—'):<18}{self.message[:48]}"
+        )
+
+
+_INCIDENT_HEADER = (
+    f"{'label':<30}{'detector':<22}{'sev':<8}{'onset':>8}  "
+    f"{'config_hash':<14}{'algorithm':<18}message"
+)
+
+
+def build_incident_index(root, **filters) -> list[IncidentRecord]:
+    """Index every ``kind='incident'`` JSONL record under ``root``
+    (the bundles ``monitors.write_incidents`` leaves next to RunTrace
+    manifests). ``filters``: detector=, severity=, config_hash=,
+    structural_hash=, label= (substring) — all ANDed, the
+    ``build_index`` convention."""
+    records = []
+    for blob, path, line in iter_manifests(root):
+        if not isinstance(blob, dict) or blob.get("kind") != "incident":
+            continue
+        cfg = blob.get("config") or {}
+        rec = IncidentRecord(
+            path=str(path),
+            line=line,
+            label=str(blob.get("label") or path.stem),
+            detector=str(blob.get("detector") or "—"),
+            severity=str(blob.get("severity") or "—"),
+            onset_iteration=blob.get("onset_iteration"),
+            message=str(blob.get("message") or ""),
+            config_hash=blob.get("config_hash"),
+            structural_hash=blob.get("structural_hash"),
+            algorithm=cfg.get("algorithm") if isinstance(cfg, dict) else None,
+        )
+        if _matches(rec, filters):
+            records.append(rec)
+    return records
+
+
+def incident_counts(root) -> dict[str, int]:
+    """config_hash → incident count under ``root`` — the join key the
+    ``list --with-incidents`` column uses (an incident bundle records
+    the full config, so its content hash matches its run's manifest)."""
+    counts: dict[str, int] = {}
+    for rec in build_incident_index(root):
+        if rec.config_hash:
+            counts[rec.config_hash] = counts.get(rec.config_hash, 0) + 1
+    return counts
+
+
+def index_with_incident_counts(
+    root, **filters
+) -> tuple[list[RunRecord], dict[str, int]]:
+    """``(build_index(root, **filters), incident_counts(root))`` in ONE
+    directory walk — ``list --with-incidents`` reads both from the same
+    corpus, and a scenario-engine-sized runs/ directory should not pay
+    the JSON decode twice."""
+    records: list[RunRecord] = []
+    counts: dict[str, int] = {}
+    for blob, path, line in iter_manifests(root):
+        if not isinstance(blob, dict):
+            continue
+        if blob.get("kind") == "incident":
+            ch = blob.get("config_hash")
+            if ch:
+                counts[ch] = counts.get(ch, 0) + 1
+            continue
+        rec = _record_from_manifest(blob, path, line)
+        if rec is not None and _matches(rec, filters):
+            records.append(rec)
+    return records, counts
+
+
 def _matches(rec: RunRecord, filters: dict) -> bool:
     for key, want in filters.items():
         if want is None:
@@ -251,6 +351,19 @@ def compare_manifests(a: dict, b: dict) -> dict:
          b.get("compile_seconds")),
     ):
         headline[key] = {"a": va, "b": vb, "b_over_a": ratio(va, vb)}
+
+    def inc_block(h):
+        inc = (h or {}).get("incidents") or {}
+        return {
+            "count": int(inc.get("count", 0)),
+            "fatal": int(inc.get("fatal", 0)),
+            "detectors": sorted({
+                an.get("detector") for an in inc.get("anomalies", [])
+                if an.get("detector")
+            }),
+        }
+
+    inc_a, inc_b = inc_block(ha), inc_block(hb)
     return {
         "a": {"label": a.get("label") or a.get("artifact"),
               "config_hash": a.get("config_hash")},
@@ -267,6 +380,20 @@ def compare_manifests(a: dict, b: dict) -> dict:
         "config_diff": config_diff,
         "provenance_diff": prov_diff,
         "headline": headline,
+        # Anomaly-sentinel delta (ISSUE-13): which run carried incidents,
+        # how many, which detectors — the first thing to look at when two
+        # runs of one config disagree.
+        "incidents": {
+            "a": inc_a,
+            "b": inc_b,
+            "delta": inc_b["count"] - inc_a["count"],
+            "detectors_only_in_b": sorted(
+                set(inc_b["detectors"]) - set(inc_a["detectors"])
+            ),
+            "detectors_only_in_a": sorted(
+                set(inc_a["detectors"]) - set(inc_b["detectors"])
+            ),
+        },
     }
 
 
@@ -366,6 +493,22 @@ PERF_TOLERANCES: dict[str, tuple[Check, ...]] = {
         Check("gates.parity_max_objective_rel_deviation_f64",
               rtol=1.0, atol_floor=1e-12, direction="max"),
         Check("gates.n100k_ici_bytes_per_device_per_round", equal=True),
+    ),
+    "monitors.json": (
+        # The anomaly sentinel (ISSUE-13): every gate boolean — monitor
+        # overhead within the ≤5% ceiling on the sequential AND async
+        # paths, monitors-on bitwise == off, the planted f>b divergence
+        # firing with onset inside the 2-eval-window envelope, the
+        # early halt actually saving work, and the incident bundle
+        # naming the attacker context — must reproduce exactly; the
+        # measured overhead fractions get a generous ceiling envelope.
+        Check("gates.*", equal=True, bool_only=True),
+        Check("overhead.overhead_frac", rtol=1.0, direction="max",
+              atol_floor=0.05),
+        Check("async.overhead_frac", rtol=1.0, direction="max",
+              atol_floor=0.05),
+        Check("divergence.onset_error_eval_windows", rtol=0.0,
+              direction="max", atol_floor=2.0),
     ),
 }
 
@@ -482,8 +625,7 @@ def perf_diff(
 
 
 def _cmd_list(args) -> int:
-    records = build_index(
-        args.root,
+    filters = dict(
         config_hash=args.config_hash,
         structural_hash=args.structural_hash,
         backend=args.backend,
@@ -491,16 +633,54 @@ def _cmd_list(args) -> int:
         kind=args.kind,
         label=args.label,
     )
+    if args.with_incidents:
+        records, counts = index_with_incident_counts(args.root, **filters)
+    else:
+        records, counts = build_index(args.root, **filters), None
+
+    def n_inc(rec):
+        return counts.get(rec.config_hash, 0) if counts is not None else None
+
+    if args.json:
+        rows = []
+        for rec in records:
+            d = dataclasses.asdict(rec)
+            if counts is not None:
+                d["incidents"] = n_inc(rec)
+            rows.append(d)
+        print(json.dumps(rows, indent=1))
+        return 0
+    header = _HEADER + ("  incidents" if counts is not None else "")
+    print(header)
+    print("-" * len(header))
+    for rec in records:
+        line = rec.row()
+        if counts is not None:
+            line += f"  {n_inc(rec):>9}"
+        print(line)
+    print(f"{len(records)} manifest(s) under {args.root}")
+    return 0
+
+
+def _cmd_incidents(args) -> int:
+    records = build_incident_index(
+        args.root,
+        detector=args.detector,
+        severity=args.severity,
+        config_hash=args.config_hash,
+        structural_hash=args.structural_hash,
+        label=args.label,
+    )
     if args.json:
         print(json.dumps(
             [dataclasses.asdict(r) for r in records], indent=1,
         ))
         return 0
-    print(_HEADER)
-    print("-" * len(_HEADER))
+    print(_INCIDENT_HEADER)
+    print("-" * len(_INCIDENT_HEADER))
     for rec in records:
         print(rec.row())
-    print(f"{len(records)} manifest(s) under {args.root}")
+    print(f"{len(records)} incident(s) under {args.root}")
     return 0
 
 
@@ -525,6 +705,22 @@ def _cmd_compare(args) -> int:
             f"  {k}: {row['a']} vs {row['b']}"
             + (f"  (B/A = {r:.3f})" if r is not None else "")
         )
+    inc = diff["incidents"]
+    if inc["a"]["count"] or inc["b"]["count"]:
+        print(
+            f"  incidents: {inc['a']['count']} vs {inc['b']['count']} "
+            f"(delta {inc['delta']:+d})"
+        )
+        if inc["detectors_only_in_b"]:
+            print(
+                "    fired only in B: "
+                + ", ".join(inc["detectors_only_in_b"])
+            )
+        if inc["detectors_only_in_a"]:
+            print(
+                "    fired only in A: "
+                + ", ".join(inc["detectors_only_in_a"])
+            )
     return 0
 
 
@@ -579,8 +775,28 @@ def main(argv=None) -> int:
                     choices=("run_trace", "bench_manifest"))
     pl.add_argument("--label", default=None,
                     help="case-insensitive substring on label/artifact")
+    pl.add_argument("--with-incidents", action="store_true",
+                    help="join anomaly-sentinel incident bundles under "
+                         "the same root onto the listing (per-manifest "
+                         "incident count column, keyed by config hash)")
     pl.add_argument("--json", action="store_true")
     pl.set_defaults(fn=_cmd_list)
+
+    pi = sub.add_parser(
+        "incidents",
+        help="list anomaly-sentinel incident bundles (the JSONL the "
+             "monitors write next to RunTrace manifests)",
+    )
+    pi.add_argument("root", help="directory (or single file) to index")
+    pi.add_argument("--detector", default=None)
+    pi.add_argument("--severity", default=None,
+                    choices=("info", "warn", "fatal"))
+    pi.add_argument("--config-hash", default=None)
+    pi.add_argument("--structural-hash", default=None)
+    pi.add_argument("--label", default=None,
+                    help="case-insensitive substring on the run label")
+    pi.add_argument("--json", action="store_true")
+    pi.set_defaults(fn=_cmd_incidents)
 
     pc = sub.add_parser(
         "compare", help="field-level diff of two manifests",

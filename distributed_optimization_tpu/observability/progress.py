@@ -200,21 +200,16 @@ def make_live_bhat(config, max_cells: int = 200_000):
         return None
     if config.gossip_schedule != "synchronous":
         return None
-    faults_active = (
-        config.edge_drop_prob > 0.0
-        or config.straggler_prob > 0.0
-        or config.mttf > 0.0
-        or config.participation_rate < 1.0
-    )
-    if not faults_active:
-        return None
     from distributed_optimization_tpu.parallel import build_topology
     from distributed_optimization_tpu.parallel.faults import (
         _edge_list,
-        build_fault_timeline,
+        config_faults_active,
+        timeline_for_config,
         windowed_connectivity,
     )
 
+    if not config_faults_active(config):
+        return None
     topo = build_topology(
         config.topology, config.n_workers,
         erdos_renyi_p=config.erdos_renyi_p,
@@ -224,16 +219,7 @@ def make_live_bhat(config, max_cells: int = 200_000):
     n_edges = max(len(_edge_list(topo)), 1)
     if config.n_iterations * n_edges > max_cells:
         return None
-    tl = build_fault_timeline(
-        topo, config.n_iterations, config.seed,
-        edge_drop_prob=config.edge_drop_prob,
-        burst_len=config.burst_len if config.burst_len >= 1.0 else 1.0,
-        straggler_prob=(
-            0.0 if config.mttf > 0.0 else config.straggler_prob
-        ),
-        mttf=config.mttf, mttr=config.mttr,
-        participation_rate=config.participation_rate,
-    )
+    tl = timeline_for_config(config, topo, config.n_iterations)
 
     def prefix(arr, t):
         return None if arr is None else arr[:t]

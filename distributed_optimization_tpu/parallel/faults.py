@@ -379,6 +379,43 @@ def _edge_list(topo: Topology) -> np.ndarray:
     return np.stack([ei, ej], axis=1).astype(np.int32)
 
 
+def config_faults_active(config) -> bool:
+    """Whether this config runs ANY synchronous node/edge fault process —
+    the single definition shared by every consumer that decides to
+    rebuild a timeline from a config (live-B̂ heartbeats, the health
+    block's realized B̂, incident forensics)."""
+    return (
+        config.edge_drop_prob > 0.0
+        or config.straggler_prob > 0.0
+        or config.mttf > 0.0
+        or config.participation_rate < 1.0
+    )
+
+
+def timeline_for_config(config, topo: Topology, horizon: int,
+                        seed=None) -> FaultTimeline:
+    """The canonical config → ``build_fault_timeline`` parameter mapping.
+
+    This mapping IS the bitwise purity contract: the timeline a consumer
+    rebuilds host-side (telemetry's realized B̂, the live-B̂ heartbeat
+    probe, incident forensics, the replica-batched stacker) must be the
+    realization the backend executed, so the burst clamp and the
+    straggler-vs-churn exclusivity rule live in exactly one place.
+    ``seed`` overrides ``config.seed`` (the replica-batched path passes
+    per-replica seeds).
+    """
+    return build_fault_timeline(
+        topo, horizon, config.seed if seed is None else seed,
+        edge_drop_prob=config.edge_drop_prob,
+        burst_len=config.burst_len if config.burst_len >= 1.0 else 1.0,
+        straggler_prob=(
+            0.0 if config.mttf > 0.0 else config.straggler_prob
+        ),
+        mttf=config.mttf, mttr=config.mttr,
+        participation_rate=config.participation_rate,
+    )
+
+
 def build_fault_timeline(
     topo: Topology,
     horizon: int,

@@ -259,6 +259,21 @@ def _health_section(records) -> list[str]:
                     f"B/dev/round over P={ici['worker_mesh']} mesh "
                     f"(halo {ici['halo_rows_max']} rows)"
                 )
+        inc = h.get("incidents")
+        if inc is not None and inc.get("count"):
+            # Anomaly sentinel (ISSUE-13): the run fired detectors — the
+            # report names the worst one and whether the halt policy cut
+            # the run short; the full forensics live in the incident
+            # bundles / manifest health block.
+            worst = inc["anomalies"][0]
+            line = (
+                f"INCIDENTS {inc['count']} ({inc['fatal']} fatal): "
+                f"{worst['detector']} [{worst['severity']}] at iter "
+                f"{worst['onset_iteration']}"
+            )
+            if inc.get("halted_at") is not None:
+                line += f"; HALTED at iter {inc['halted_at']}"
+            parts.append(line)
         if parts:
             lines.append(f"  {rec.label:<26}" + ", ".join(parts))
     return lines

@@ -60,12 +60,32 @@ class EngineRunError(RuntimeError):
     structured error message."""
 
 
+def triage_cell(incidents, run_error=None) -> str:
+    """Mechanical cell triage (ISSUE-13): sweeps separate 'converged'
+    (no anomaly fired), 'validly_degraded' (warn-severity incidents only
+    — the cell degraded the way its fault/attack composition is allowed
+    to), and 'pathological' (a fatal incident, or the run itself failed)
+    without a human reading per-cell curves."""
+    if run_error is not None:
+        return "pathological"
+    if any(i.get("severity") == "fatal" for i in incidents):
+        return "pathological"
+    if incidents:
+        return "validly_degraded"
+    return "converged"
+
+
 def _reset_scenario_gauges(reg) -> dict:
     gauges = {
         "sampled": reg.gauge(
             "dopt_scenario_cells_sampled",
             "Cells drawn from the composition matrix in the last "
             "scenario-engine run",
+        ),
+        "triage": reg.gauge(
+            "dopt_scenario_cells_triage",
+            "Completed cells of the last scenario-engine run by triage "
+            "class (converged / validly_degraded / pathological)",
         ),
         "valid": reg.gauge(
             "dopt_scenario_cells_valid",
@@ -242,6 +262,9 @@ class ScenarioEngine:
         rows: list[dict[str, Any]] = []
         n_checks = n_failures = n_run_errors = 0
         by_invariant: dict[str, dict[str, int]] = {}
+        triage_counts = {
+            "converged": 0, "validly_degraded": 0, "pathological": 0,
+        }
         for cell in sample.cells:
             row = cell.row()
             if not cell.valid:
@@ -255,8 +278,19 @@ class ScenarioEngine:
             if failed:
                 n_run_errors += 1
                 row["run_error"] = failed[0].error
+                row["triage"] = triage_cell([], run_error=failed[0].error)
+                triage_counts[row["triage"]] += 1
                 rows.append(row)
                 continue
+            # Anomaly-sentinel incidents per cell (ISSUE-13): the serving
+            # layer's per-request monitor banks watched every replica of
+            # this cell; triage separates converged / validly degraded /
+            # pathological cells mechanically.
+            incidents = [i for r in requests for i in r.incidents]
+            if incidents:
+                row["incidents"] = incidents
+            row["triage"] = triage_cell(incidents)
+            triage_counts[row["triage"]] += 1
             results = [r.result for r in requests]
             self._served.setdefault(requests[0].config, results[0])
             row["serving"] = requests[0].serving_block()
@@ -293,6 +327,8 @@ class ScenarioEngine:
             rows.append(row)
         gauges["checks"].set(n_checks)
         gauges["failures"].set(n_failures)
+        for cls, count in triage_counts.items():
+            gauges["triage"].set(count, **{"class": cls})
 
         replay = self._warm_replay(sample, submissions)
 
@@ -320,6 +356,7 @@ class ScenarioEngine:
                 "checks": n_checks, "failures": n_failures,
                 "by_name": by_invariant,
             },
+            "triage": triage_counts,
             "serving": serving,
             "warm_replay": replay,
             "gates": {

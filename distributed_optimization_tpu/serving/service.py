@@ -101,6 +101,13 @@ class ServingOptions:
     live progress streams (``/v1/progress/<id>``): every executed plan
     runs with progress on, in segments of this many eval-chunks — the
     continuation machinery, bitwise the one-shot program.
+    ``monitors`` (ISSUE-13) attaches one anomaly ``MonitorBank`` per
+    request to those heartbeats: detector firings surface as structured
+    incidents in ``/v1/status`` and as ``kind='anomaly'`` events on the
+    request's progress stream, and land in the response manifest's
+    health block. Observation only — the serving plane never halts a
+    paying request (``halt_on='never'``); it costs one Python callback
+    per heartbeat.
     """
 
     window_s: float = 0.05
@@ -112,6 +119,7 @@ class ServingOptions:
     # cost ~14% there, every-5 ~4%, and a served cohort's wall time is
     # dominated by its compile anyway).
     progress_every: int = 5
+    monitors: bool = True
 
     def __post_init__(self) -> None:
         if self.progress_every < 1:
@@ -161,6 +169,9 @@ class Request:
     cache_hit: Optional[bool] = None
     queue_wait_s: Optional[float] = None
     run_wall_s: Optional[float] = None
+    # Anomaly-sentinel firings observed on this request's heartbeats
+    # (ISSUE-13): compact anomaly dicts, appended live as detectors fire.
+    incidents: list = dataclasses.field(default_factory=list)
 
     def status_dict(self) -> dict:
         """The JSON-safe view the daemon returns for status polls."""
@@ -171,6 +182,15 @@ class Request:
         }
         if self.error is not None:
             out["error"] = self.error
+        if self.incidents:
+            out["incidents"] = [
+                {
+                    "detector": i["detector"],
+                    "severity": i["severity"],
+                    "onset_iteration": i["onset_iteration"],
+                }
+                for i in self.incidents
+            ]
         if self.status in (DONE, FAILED):
             out["serving"] = self.serving_block()
         return out
@@ -259,6 +279,8 @@ class SimulationService:
         self.n_failed = 0
         self.n_sequential = 0
         self.n_cohorts = 0
+        # Anomaly-sentinel firings across all served requests (ISSUE-13).
+        self.n_incidents = 0
         self.data_gen_seconds = 0.0
         self.oracle_seconds = 0.0
         # Span tracing (ISSUE-10): request → cohort → compile/run spans,
@@ -423,10 +445,51 @@ class SimulationService:
         """Heartbeat plumbing for one executed plan (ISSUE-10): sequential
         requests get their own backend callback; a batched cohort's
         heartbeats fan out to every member with ITS replica's gap swapped
-        in (the cohort-level mean stays in ``extra``)."""
+        in (the cohort-level mean stays in ``extra``).
+
+        Anomaly sentinel (ISSUE-13): with ``options.monitors`` on, every
+        request gets its own ``MonitorBank`` watching exactly the
+        heartbeats its stream carries; a firing is appended to
+        ``req.incidents`` (surfaced by ``/v1/status``) and published as a
+        ``kind='anomaly'`` event on the stream, so a follower sees the
+        diagnosis inline with the progress it rode in on."""
+        banks: dict[str, Any] = {}
+        if self.options.monitors:
+            from distributed_optimization_tpu.observability.monitors import (
+                MonitorBank,
+            )
+
+            for req in plan.requests:
+                # Observation only: the serving plane records and
+                # surfaces, it never halts a request mid-flight.
+                banks[req.id] = MonitorBank(
+                    req.config, halt_on="never", label=req.id,
+                )
+
+        def deliver(req, ev):
+            req.progress.publish(ev)
+            bank = banks.get(req.id)
+            if bank is None:
+                return
+            for anomaly in bank.observe(ev):
+                req.incidents.append(anomaly.to_dict())
+                with self._lock:
+                    self.n_incidents += 1
+                req.progress.publish(ProgressEvent(
+                    kind="anomaly",
+                    iteration=int(anomaly.onset_iteration),
+                    n_iterations=req.config.n_iterations,
+                    wall_seconds=ev.wall_seconds,
+                    status=f"anomaly:{anomaly.detector}",
+                    extra={
+                        "detector": anomaly.detector,
+                        "severity": anomaly.severity,
+                        "message": anomaly.message,
+                    },
+                ))
 
         def progress_factory(req):
-            return req.progress.publish
+            return lambda ev: deliver(req, ev)
 
         def cohort_cb(ev):
             per_replica = ev.gap_per_replica
@@ -439,9 +502,9 @@ class SimulationService:
                     )
                 else:
                     ev_r = ev
-                req.progress.publish(ev_r)
+                deliver(req, ev_r)
 
-        return progress_factory, cohort_cb
+        return progress_factory, cohort_cb, banks
 
     def _execute(self, plan) -> None:
         t_start = time.perf_counter()
@@ -458,7 +521,7 @@ class SimulationService:
                 extra={"cohort_size": plan.size,
                        "coalesced": plan.coalesced},
             ))
-        progress_factory, cohort_cb = self._plan_progress(plan)
+        progress_factory, cohort_cb, banks = self._plan_progress(plan)
         # Per-plan span tree (request → cohort → compile/run → the
         # backend's chunks): embedded in each member's manifest and
         # aggregated into the service tracer's flat phases.
@@ -524,6 +587,18 @@ class SimulationService:
         )
         for req, res in zip(plan.requests, results):
             req.result = res
+            bank = banks.get(req.id)
+            if bank is not None and res.history.trace is not None:
+                # Trace-derived detectors (screening saturation, the
+                # non-finite state sentinel) see the flight recorder
+                # buffers the request opted into.
+                new = bank.scan_trace(
+                    res.history.trace, res.history.eval_iterations
+                )
+                if new:
+                    with self._lock:
+                        self.n_incidents += len(new)
+                req.incidents = [a.to_dict() for a in bank.anomalies]
             # Race-free per-request cache fact: the service always
             # measures compile, so zero compile seconds on a cached jax
             # path means this request's executable came from the cache —
@@ -536,7 +611,8 @@ class SimulationService:
             )
             req.run_wall_s = wall
             req.manifest = self._manifest(
-                req, res, spans=plan_tracer.chrome_events()
+                req, res, spans=plan_tracer.chrome_events(),
+                bank=bank,
             )
             req.status = DONE
             self._finish(req)
@@ -565,16 +641,19 @@ class SimulationService:
             while len(self._done_order) > self.options.max_done:
                 self._requests.pop(self._done_order.popleft(), None)
 
-    def _manifest(self, req: Request, res, spans=None) -> dict:
+    def _manifest(self, req: Request, res, spans=None, bank=None) -> dict:
         """The request's RunTrace manifest (the daemon's response body):
         config + hash, phases, trace buffers when the request asked for
-        telemetry, the health block extended with the serving facts, and
-        (schema v2) the plan's span tree."""
+        telemetry, the health block extended with the serving facts and
+        any anomaly-sentinel incidents (ISSUE-13), and (schema v2) the
+        plan's span tree."""
         from distributed_optimization_tpu import telemetry
 
         health = telemetry.health_summary(
             req.config, res.history, serving=req.serving_block(),
         )
+        if bank is not None and bank.anomalies:
+            health["incidents"] = bank.summary()
         return telemetry.build_run_trace(
             req.id, req.config, res.history,
             phases={
@@ -660,6 +739,10 @@ class SimulationService:
                 "requests_done": self.n_done,
                 "requests_failed": self.n_failed,
                 "requests_sequential_fallback": self.n_sequential,
+                # Anomaly-sentinel firings over all served requests
+                # (ISSUE-13); per-request details ride each request's
+                # status_dict/manifest, this is the fleet-level count.
+                "incidents_total": self.n_incidents,
                 # count is lifetime; mean/max summarize the most recent
                 # window (the deques are bounded — see __init__).
                 "cohorts": {

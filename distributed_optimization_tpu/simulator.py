@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import time
+from pathlib import Path
 from typing import Optional
 
 import numpy as np
@@ -85,6 +86,10 @@ class ExperimentRecord:
     # the run recorded flight-recorder trace buffers (config.telemetry);
     # read by format_report's run-health section and the RunTrace manifest.
     health: Optional[dict] = None
+    # The run's anomaly MonitorBank (ISSUE-13) when one watched it —
+    # carries the fired anomalies/halt facts; ``write_incidents`` drains
+    # the forensic bundles.
+    monitors: Optional[object] = None
 
 
 class Simulator:
@@ -149,6 +154,13 @@ class Simulator:
                 else f"{cfg.algorithm} ({cfg.topology})"
             )
         kwargs = dict(run_kwargs or {})
+        # Anomaly monitors (ISSUE-13): a MonitorBank is per-run state
+        # (latched detectors), so suite/matrix callers pass a FACTORY
+        # (config -> bank) and each run gets a fresh one; a bank instance
+        # passes through untouched for single runs.
+        monitors = kwargs.get("monitors")
+        if monitors is not None and not hasattr(monitors, "observe"):
+            monitors = kwargs["monitors"] = monitors(cfg)
         replicated = cfg.replicas > 1 or "seeds" in kwargs or "sweep" in kwargs
         if verbose:
             rep = (
@@ -214,7 +226,11 @@ class Simulator:
             spectral_gap=result.history.spectral_gap,
         )
         health = None
-        if cfg.telemetry or cfg.execution == "async" or cfg.worker_mesh >= 2:
+        if (
+            cfg.telemetry or cfg.execution == "async"
+            or cfg.worker_mesh >= 2
+            or (monitors is not None and monitors.anomalies)
+        ):
             # Async runs carry no in-scan trace buffers, but their health
             # block (staleness histogram, virtual-clock skew, floats per
             # virtual second) derives from the presampled event timeline
@@ -226,9 +242,26 @@ class Simulator:
             health = health_summary(
                 cfg, result.history, d_features=self.dataset.n_features
             )
+        if monitors is not None and monitors.anomalies:
+            # The sentinel's verdict rides the health block (the report
+            # prints it; the RunTrace manifest records it).
+            health["incidents"] = monitors.summary()
+            for a in monitors.anomalies:
+                _log.warning(
+                    "%r: anomaly %s (%s) at iteration %d: %s",
+                    label, a.detector, a.severity, a.onset_iteration,
+                    a.message,
+                )
+            if monitors.halted_at is not None:
+                _log.warning(
+                    "%r: run HALTED at iteration %d of %d "
+                    "(halt_on=fatal) — histories cover the executed "
+                    "prefix only", label, monitors.halted_at,
+                    cfg.n_iterations,
+                )
         record = ExperimentRecord(
             label, cfg, result, summary, batch=batch, replicate_stats=stats,
-            health=health,
+            health=health, monitors=monitors,
         )
         self.records.append(record)
         if verbose:
@@ -344,6 +377,28 @@ class Simulator:
 
         write_jsonl(path, self.run_traces())
         _log.info("telemetry manifests saved to %s", path)
+
+    def write_incidents(self, path) -> Path:
+        """Serialize every monitored record's anomaly bundles as incident
+        JSONL (ISSUE-13; ``observability/monitors.py``) — the file
+        ``observatory incidents`` indexes. Returns the path; writes an
+        empty file when nothing fired (an empty incident log is a
+        statement, not an omission)."""
+        from distributed_optimization_tpu.observability.monitors import (
+            write_incidents,
+        )
+
+        bundles = []
+        for rec in self.records:
+            bank = rec.monitors
+            if bank is None or not bank.anomalies:
+                continue
+            bundles.extend(bank.incidents(label=rec.label))
+        out = write_incidents(path, bundles)
+        _log.info(
+            "%d incident bundle(s) saved to %s", len(bundles), out
+        )
+        return out
 
     def write_chrome_trace(self, path) -> None:
         """Export the simulator's span tree (data_gen/oracle + per-run

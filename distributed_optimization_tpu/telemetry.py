@@ -387,7 +387,8 @@ def realized_bhat(
     from distributed_optimization_tpu.parallel.faults import (
         _edge_list,
         _union_connected,
-        build_fault_timeline,
+        config_faults_active,
+        timeline_for_config,
         windowed_connectivity,
     )
 
@@ -402,29 +403,14 @@ def realized_bhat(
         topo = _config_topology(config)
     edges = _edge_list(topo)
     n_edges = max(len(edges), 1)
-    faults_active = (
-        config.edge_drop_prob > 0.0
-        or config.straggler_prob > 0.0
-        or config.mttf > 0.0
-        or config.participation_rate < 1.0
-    )
-    if not faults_active:
+    if not config_faults_active(config):
         connected = _union_connected(
             np.ones(len(edges), dtype=bool), edges, config.n_workers
         )
         return {"bhat": 1 if connected else None,
                 "horizon": config.n_iterations}
     horizon = min(config.n_iterations, max(1, max_cells // n_edges))
-    tl = build_fault_timeline(
-        topo, horizon, config.seed,
-        edge_drop_prob=config.edge_drop_prob,
-        burst_len=config.burst_len if config.burst_len >= 1.0 else 1.0,
-        straggler_prob=(
-            0.0 if config.mttf > 0.0 else config.straggler_prob
-        ),
-        mttf=config.mttf, mttr=config.mttr,
-        participation_rate=config.participation_rate,
-    )
+    tl = timeline_for_config(config, topo, horizon)
     return {"bhat": windowed_connectivity(tl, topo),
             "horizon": horizon}
 

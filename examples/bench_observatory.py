@@ -19,11 +19,15 @@ serving daemon with live work:
   dispatch+sync (~1 ms here), which is pure latency this single-core
   CPU cannot hide; pick the cadence for the run length (the serving
   default is 5).
-- ASYNC cell: the event path's chunk-loop heartbeats (staleness
-  quantiles included), recorded with an honest ``overhead_ok`` flag but
-  no hard gate: the async chunk loop trades the fused outer scan for
-  per-chunk dispatch, which is a latency-bound cost this CPU container
-  exaggerates.
+- ASYNC cell: the event path's heartbeats (staleness quantiles
+  included) at ``progress_every=6`` — 4 heartbeats/run over 24
+  eval chunks (T=1200), the
+  heartbeat-cell protocol. Since ISSUE-13 the async progress path
+  executes as fused outer-scan SEGMENTS split at heartbeat boundaries
+  (one host sync per heartbeat, not per eval chunk — the original
+  per-chunk loop measured an honest ``overhead_ok: false`` at 12.3%
+  here), so the cell now carries a REAL asserted gate:
+  ``ASYNC_OVERHEAD_CEILING`` (5%).
 - SCRAPE cell: boot the serving daemon, keep a request in flight, and
   measure ``GET /metrics`` latency (p50/p95 over 50 scrapes) — the
   consistent-snapshot lock must not make scrapes expensive. Asserted
@@ -48,6 +52,12 @@ from pathlib import Path
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 OVERHEAD_CEILING = 0.03       # asserted heartbeat-on steady-state overhead
+# The async cell's asserted ceiling (ISSUE-13 satellite): looser than the
+# sequential cell's because each async heartbeat also computes staleness
+# percentiles over the executed window, but a HARD gate — the segment-
+# fused execution replaced the per-chunk host loop that forced the old
+# honest overhead_ok=false at 12.3%.
+ASYNC_OVERHEAD_CEILING = 0.05
 SCRAPE_P95_CEILING_MS = 100.0  # asserted /metrics p95 under live load
 
 
@@ -146,14 +156,14 @@ def main() -> None:
     with timer.phase("async"):
         acfg = base.replace(
             execution="async", latency_model="exponential",
-            latency_mean=1.0, n_iterations=600, eval_every=50,
+            latency_mean=1.0, n_iterations=1200, eval_every=50,
         )
         a_ips = {"off": [], "on": []}
         a_last = {}
         for _ in range(args.cycles):
             for arm, kw in (
                 ("off", {}),
-                ("on", {"progress_cb": _noop, "progress_every": 2}),
+                ("on", {"progress_cb": _noop, "progress_every": 6}),
             ):
                 r = jax_backend.run(acfg, ds, f_opt, **kw)
                 a_ips[arm].append(r.history.iters_per_second)
@@ -169,12 +179,18 @@ def main() -> None:
             "ips_off_median": a_off,
             "ips_on_median": a_on,
             "overhead_frac": a_overhead,
-            # Honest flag, no hard gate: the async progress path trades
-            # the fused outer scan for per-chunk dispatch — latency-bound
-            # cost this container exaggerates (see docstring).
-            "overhead_ok": a_overhead <= OVERHEAD_CEILING,
+            # A REAL gate since ISSUE-13 (segment-fused execution): one
+            # host sync per heartbeat, 4 heartbeats over 12 eval chunks.
+            "overhead_ok": a_overhead <= ASYNC_OVERHEAD_CEILING,
             "off_on_bitwise_objective": a_bitwise,
+            "progress_every": 6,
         }
+        if not skip:
+            assert a_overhead <= ASYNC_OVERHEAD_CEILING, (
+                f"async heartbeat overhead {a_overhead:.1%} exceeds the "
+                f"{ASYNC_OVERHEAD_CEILING:.0%} ceiling (set "
+                "BENCH_NO_RANGE_CHECK=1 on non-canonical hardware)"
+            )
 
     # ----------------------------------------------- /metrics scrape cell
     with timer.phase("scrape"):
@@ -259,8 +275,10 @@ def main() -> None:
 
     gates = {
         "overhead_ceiling": OVERHEAD_CEILING,
+        "async_overhead_ceiling": ASYNC_OVERHEAD_CEILING,
         "scrape_p95_ceiling_ms": SCRAPE_P95_CEILING_MS,
         "heartbeat_within_ceiling": heartbeat["overhead_ok"],
+        "async_within_ceiling": async_cell["overhead_ok"],
         "off_on_bitwise_objective": (
             heartbeat["off_on_bitwise_objective"]
             and async_cell["off_on_bitwise_objective"]
@@ -275,18 +293,20 @@ def main() -> None:
             f"vs on (progress_every=15 -> 4 heartbeats/run asserted; "
             f"every-5 and every-eval arms recorded unasserted) interleaved "
             f"x{args.cycles} cycles, median steady-state iters/sec; async "
-            "cell T=600 events path; /metrics p50/p95 over 50 scrapes "
-            "against a daemon with a background submitter keeping cohorts "
-            "in flight"
+            "cell T=1200 events path at progress_every=6 (segment-fused, "
+            "≤5% asserted); /metrics p50/p95 over 50 scrapes against a "
+            "daemon with a background submitter keeping cohorts in flight"
         ),
         "note": (
             "Progress on executes the SAME compiled scan as segments split "
             "at eval boundaries (continuation machinery), so trajectories "
             "are asserted bitwise off==on; the cost is one host sync + "
-            "callback per heartbeat. The async cell swaps the fused outer "
-            "scan for a per-chunk loop — honest overhead_ok flag, no hard "
-            "gate on this latency-bound container. Scrapes render the "
-            "whole registry under one lock (consistent snapshot)."
+            "callback per heartbeat. The async cell runs the ISSUE-13 "
+            "segment-fused form (segments of progress_every chunks per "
+            "compiled call) — the old per-chunk host loop's honest "
+            "overhead_ok=false at 12.3% is replaced by a real ≤5% gate. "
+            "Scrapes render the whole registry under one lock "
+            "(consistent snapshot)."
         ),
         "heartbeat": heartbeat,
         "async": async_cell,

@@ -28,7 +28,8 @@ python examples/bench_sweep.py             # -> docs/perf/sweep.json (replica-ba
 python examples/bench_telemetry.py         # -> docs/perf/telemetry.json (overhead-ceiling gated)
 python examples/bench_fused_robust.py      # -> docs/perf/fused_robust.json (compiled-path floor gated)
 python examples/bench_serving.py           # -> docs/perf/serving.json (latency/throughput floors gated)
-python examples/bench_observatory.py       # -> docs/perf/observatory.json (heartbeat-overhead ceiling + /metrics scrape gated)
+python examples/bench_observatory.py       # -> docs/perf/observatory.json (heartbeat-overhead ceiling incl. async segment-fused cell + /metrics scrape gated)
+python examples/bench_monitors.py          # -> docs/perf/monitors.json (anomaly-sentinel overhead/onset/halt gated)
 python examples/bench_federated.py         # -> docs/perf/federated.json (floats-to-eps floor + N=10k completion gated)
 python examples/bench_async.py             # -> docs/perf/async.json (wall-clock-to-eps floors + degenerate sync gate)
 python examples/bench_worker_mesh.py       # -> docs/perf/worker_mesh.json (sharded parity bitwise + N=100k completion + flat per-device memory gated; forces 4 host devices itself)

@@ -1,0 +1,596 @@
+"""Anomaly-sentinel tests (ISSUE-13; docs/OBSERVABILITY.md).
+
+Four guarantees are pinned here:
+
+1. DETECTOR SEMANTICS on injected synthetic heartbeats/traces — each
+   detector has a firing case with the EXACT onset asserted and a
+   healthy non-firing case, severities are totally ordered, and a bank
+   latches (one firing per detector per run) while feeding the
+   ``dopt_anomaly_*`` metric families.
+2. MONITORS-ON bitwise parity — a bank observing a healthy run changes
+   nothing on the sequential, chunked, replica-batched, and async paths
+   (the segmented-progress contract extended to ISSUE-13).
+3. The PLANTED f > b BYZANTINE RUN — an over-budget ALIE attack against
+   trimmed-mean fires the divergence detector with onset within 2 eval
+   windows of the measured degradation; ``halt_on='fatal'`` ends the run
+   early with the executed prefix bitwise the full run's, and the
+   incident bundle names the attacker context (payload, Byzantine node
+   set, over-budget flag).
+4. FORENSICS PLUMBING — incident JSONL round-trips, the observatory
+   ``incidents`` index / ``list --with-incidents`` join / ``compare``
+   delta read it, the serving layer surfaces per-request incidents in
+   status + progress streams + manifest health, and the scenario triage
+   classifies mechanically.
+"""
+
+import dataclasses
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+from conftest import small_backend_config as small_config
+
+from distributed_optimization_tpu.backends import jax_backend
+from distributed_optimization_tpu.observability import observatory
+from distributed_optimization_tpu.observability.metrics_registry import (
+    metrics_registry,
+)
+from distributed_optimization_tpu.observability.monitors import (
+    Anomaly,
+    ConnectivityLossDetector,
+    ConsensusStallDetector,
+    DivergenceDetector,
+    MonitorBank,
+    NonFiniteDetector,
+    ScreeningSaturationDetector,
+    StalenessBlowupDetector,
+    build_incident,
+    default_detectors,
+    incidents_path_for,
+    read_incidents,
+    severity_rank,
+    write_incidents,
+)
+from distributed_optimization_tpu.observability.progress import ProgressEvent
+from distributed_optimization_tpu.utils.data import generate_synthetic_dataset
+from distributed_optimization_tpu.utils.oracle import compute_reference_optimum
+
+
+def beat(iteration, gap=None, cons=None, bhat=None, disconnected=False,
+         p50=None, p90=None, p_max=None, per_replica=None):
+    """One synthetic heartbeat in the backends' emission shape."""
+    return ProgressEvent(
+        kind="chunk", iteration=iteration, n_iterations=1000,
+        wall_seconds=0.1, gap=gap, consensus=cons, bhat=bhat,
+        staleness_p50=p50, staleness_p90=p90, staleness_max=p_max,
+        gap_per_replica=per_replica,
+        extra={"bhat_disconnected": True} if disconnected else None,
+    )
+
+
+def _setup(**kw):
+    cfg = small_config(n_iterations=40, eval_every=10, **kw)
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    return cfg, ds, f_opt
+
+
+def _diverging_config(**kw):
+    """The planted f > b cell: ALIE with 3 attackers against a b=1
+    trimmed mean on a ring (per-neighborhood budget exceeded — the sharp
+    breakdown regime of docs/perf/byzantine.json) at a learning rate the
+    attack-free twin converges under (asserted in the bench)."""
+    defaults = dict(
+        n_iterations=600, eval_every=20, learning_rate_eta0=0.3,
+        attack="alie", n_byzantine=3, attack_scale=1.5,
+        aggregation="trimmed_mean", robust_b=1,
+    )
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+# ------------------------------------------------------ detector semantics
+
+
+def test_severity_ordering():
+    assert severity_rank("fatal") > severity_rank("warn") > severity_rank(
+        "info"
+    )
+    with pytest.raises(ValueError, match="unknown severity"):
+        severity_rank("catastrophic")
+    anomalies = [
+        Anomaly("a", "warn", 10, "", {}),
+        Anomaly("b", "fatal", 30, "", {}),
+        Anomaly("c", "info", 0, "", {}),
+    ]
+    ordered = sorted(
+        anomalies, key=lambda a: -severity_rank(a.severity)
+    )
+    assert [a.detector for a in ordered] == ["b", "a", "c"]
+
+
+def test_divergence_rising_streak_exact_onset():
+    det = DivergenceDetector(window=3)
+    gaps = [(10, 1.0), (20, 0.9), (30, 0.8), (40, 1.1), (50, 1.5)]
+    assert all(det.observe(beat(t, gap=g)) is None for t, g in gaps)
+    fired = det.observe(beat(60, gap=2.0))
+    assert fired is not None and fired.severity == "fatal"
+    # Onset = the FIRST heartbeat of the rising streak (0.8 -> 1.1 at 40).
+    assert fired.onset_iteration == 40
+    assert fired.evidence["gap"][-1] == 2.0
+    # Latched: further input is ignored.
+    assert det.observe(beat(70, gap=4.0)) is None
+
+
+def test_divergence_ceiling_breach_and_healthy():
+    det = DivergenceDetector(window=3, rel_ceiling=100.0)
+    assert det.observe(beat(10, gap=2.0)) is None
+    assert det.observe(beat(20, gap=1.0)) is None
+    fired = det.observe(beat(30, gap=150.0))  # >100x best AND > first
+    assert fired is not None and fired.onset_iteration == 30
+    # Healthy: monotonically decreasing never fires.
+    healthy = DivergenceDetector(window=2)
+    for i, g in enumerate([10.0, 5.0, 2.0, 1.0, 0.5, 0.2]):
+        assert healthy.observe(beat(10 * (i + 1), gap=g)) is None
+    # Converged noise: ratios are huge but the gap stays below the first
+    # observation — the degrading guard keeps it silent.
+    noisy = DivergenceDetector(window=2, rel_ceiling=10.0)
+    for i, g in enumerate([1.0, 1e-12, 5e-9, 6e-9, 7e-9]):
+        assert noisy.observe(beat(10 * (i + 1), gap=g)) is None
+
+
+def test_divergence_judges_worst_replica():
+    det = DivergenceDetector(window=1)
+    assert det.observe(beat(10, gap=1.0, per_replica=[1.0, 1.0])) is None
+    # The cohort MEAN is flat, but the worst replica rose: fires.
+    fired = det.observe(beat(20, gap=1.0, per_replica=[0.9, 1.4]))
+    assert fired is not None
+    # A mean-only detector would have stayed silent on these beats.
+    mean_only = DivergenceDetector(window=1)
+    assert mean_only.observe(beat(10, gap=1.0)) is None
+    assert mean_only.observe(beat(20, gap=1.0)) is None
+
+
+def test_consensus_stall_fire_and_healthy():
+    det = ConsensusStallDetector(window=3, floor=1e-6)
+    for t in (10, 20, 30):
+        assert det.observe(beat(t, cons=1e-2)) is None
+    # 3 consecutive no-decrease transitions need 4 points: fires here.
+    fired = det.observe(beat(40, cons=1e-2))
+    assert fired is not None and fired.severity == "warn"
+    assert fired.onset_iteration == 20  # first stalled observation
+    # Healthy: decreasing consensus never fires.
+    h = ConsensusStallDetector(window=3, floor=1e-6)
+    for i, c in enumerate([1e-1, 1e-2, 1e-3, 1e-4, 1e-5, 1e-5 / 2]):
+        assert h.observe(beat(10 * (i + 1), cons=c)) is None
+    # Converged: flat but BELOW the floor never fires.
+    f = ConsensusStallDetector(window=3, floor=1e-6)
+    for i in range(6):
+        assert f.observe(beat(10 * (i + 1), cons=1e-9)) is None
+
+
+def test_non_finite_heartbeat_and_trace():
+    det = NonFiniteDetector()
+    assert det.observe(beat(10, gap=1.0)) is None
+    fired = det.observe(beat(20, gap=float("nan")))
+    assert fired is not None and fired.severity == "fatal"
+    assert fired.onset_iteration == 20
+    # Trace scan: first positive sentinel row names the onset iteration.
+    det2 = NonFiniteDetector()
+    trace = {"nonfinite": np.array([0.0, 0.0, 3.0, 8.0])}
+    fired2 = det2.scan_trace(trace, np.array([10, 20, 30, 40]))
+    assert fired2 is not None and fired2.onset_iteration == 30
+    det3 = NonFiniteDetector()
+    assert det3.scan_trace(
+        {"nonfinite": np.zeros(4)}, np.array([10, 20, 30, 40])
+    ) is None
+
+
+def test_connectivity_loss_disconnect_ceiling_and_na():
+    det = ConnectivityLossDetector()
+    assert det.observe(beat(10, gap=1.0, bhat=4)) is None
+    fired = det.observe(beat(20, gap=1.0, disconnected=True))
+    assert fired is not None and fired.severity == "fatal"
+    assert fired.onset_iteration == 20
+    # Ceiling breach is a warn, not fatal.
+    det2 = ConnectivityLossDetector(bhat_ceiling=8)
+    assert det2.observe(beat(10, bhat=4)) is None
+    fired2 = det2.observe(beat(20, bhat=12))
+    assert fired2 is not None and fired2.severity == "warn"
+    # Not applicable (no live-B-hat on this path): bare None never fires.
+    det3 = ConnectivityLossDetector()
+    for t in (10, 20, 30):
+        assert det3.observe(beat(t, gap=1.0)) is None
+    # A ceiling warn must NOT latch: a later genuine disconnection still
+    # fires fatal (and the warn itself fires only once).
+    det4 = ConnectivityLossDetector(bhat_ceiling=8)
+    warn = det4.observe(beat(10, bhat=12))
+    assert warn is not None and warn.severity == "warn"
+    assert det4.observe(beat(20, bhat=14)) is None  # warn fired once
+    fatal = det4.observe(beat(30, disconnected=True))
+    assert fatal is not None and fatal.severity == "fatal"
+
+
+def test_staleness_blowup_fire_and_healthy():
+    det = StalenessBlowupDetector(ceiling=32.0)
+    assert det.observe(beat(10, p50=2, p90=10, p_max=20)) is None
+    fired = det.observe(beat(20, p50=4, p90=48, p_max=90))
+    assert fired is not None and fired.onset_iteration == 20
+    assert fired.severity == "warn"
+    h = StalenessBlowupDetector(ceiling=32.0)
+    for t in (10, 20, 30):
+        assert h.observe(beat(t, p50=1, p90=8, p_max=30)) is None
+
+
+def test_screening_saturation_scan_and_healthy():
+    det = ScreeningSaturationDetector(threshold=0.9, window=2)
+    trace = {"clip_frac": np.array([0.3, 0.95, 0.97, 0.2])}
+    fired = det.scan_trace(trace, np.array([10, 20, 30, 40]))
+    assert fired is not None and fired.onset_iteration == 20
+    assert fired.severity == "warn"
+    # A healthy trimmed mean screens its fixed 2b/(deg+1) slice.
+    h = ScreeningSaturationDetector(threshold=0.9, window=2)
+    assert h.scan_trace(
+        {"clip_frac": np.full(6, 0.33)}, np.arange(10, 70, 10)
+    ) is None
+    # One saturated row among healthy ones (a transient) never fires a
+    # window=2 detector.
+    t = ScreeningSaturationDetector(threshold=0.9, window=2)
+    assert t.scan_trace(
+        {"clip_frac": np.array([0.3, 0.95, 0.3, 0.95, 0.3])},
+        np.arange(10, 60, 10),
+    ) is None
+
+
+def test_bank_latch_metrics_and_summary():
+    cfg = small_config()
+    reg = metrics_registry()
+    firings = reg.counter("dopt_anomaly_firings_total")
+    before = firings.value(detector="divergence", severity="fatal")
+    bank = MonitorBank(cfg, detectors=[DivergenceDetector(window=1)])
+    bank.observe(beat(10, gap=1.0))
+    bank.observe(beat(20, gap=2.0))
+    bank.observe(beat(30, gap=3.0))  # already latched
+    assert len(bank.anomalies) == 1
+    after = firings.value(detector="divergence", severity="fatal")
+    assert after == before + 1
+    s = bank.summary()
+    assert s["count"] == 1 and s["fatal"] == 1 and s["halted_at"] is None
+    assert s["anomalies"][0]["detector"] == "divergence"
+    # A broken detector is contained, the healthy one still fires.
+    class Boom(DivergenceDetector):
+        name = "boom"
+
+        def _observe(self, ev):
+            raise RuntimeError("broken detector")
+
+    bank2 = MonitorBank(
+        cfg, detectors=[Boom(), NonFiniteDetector()]
+    )
+    fired = bank2.observe(beat(10, gap=float("inf")))
+    assert [a.detector for a in fired] == ["non_finite"]
+
+
+def test_bank_halt_policy_validation_and_default_detectors():
+    cfg = small_config()
+    with pytest.raises(ValueError, match="halt_on"):
+        MonitorBank(cfg, halt_on="sometimes")
+    names = {d.name for d in default_detectors(cfg)}
+    assert names == {"divergence", "non_finite", "consensus_stall"}
+    names = {
+        d.name for d in default_detectors(cfg.replace(edge_drop_prob=0.2))
+    }
+    assert "connectivity_loss" in names
+    names = {
+        d.name for d in default_detectors(cfg.replace(
+            execution="async", latency_model="exponential",
+        ))
+    }
+    assert "staleness_blowup" in names
+    names = {
+        d.name for d in default_detectors(cfg.replace(
+            aggregation="trimmed_mean", robust_b=1,
+        ))
+    }
+    assert "screening_saturation" in names
+    # Overrides reach the named detector's constructor.
+    dets = default_detectors(cfg, divergence={"window": 7})
+    div = next(d for d in dets if d.name == "divergence")
+    assert div.window == 7
+
+
+# ------------------------------------------- monitors-on bitwise parity
+
+
+def test_monitors_on_bitwise_sequential_and_chunked():
+    cfg, ds, f_opt = _setup(edge_drop_prob=0.2)
+    off = jax_backend.run(cfg, ds, f_opt)
+    bank = MonitorBank(cfg, halt_on="fatal")
+    on = jax_backend.run(cfg, ds, f_opt, monitors=bank)
+    np.testing.assert_array_equal(off.history.objective, on.history.objective)
+    np.testing.assert_array_equal(off.final_models, on.final_models)
+    assert bank.anomalies == [] and bank.halted_at is None
+    # Chunked (measured-timestamps) path.
+    off_c = jax_backend.run(cfg, ds, f_opt, measure_timestamps=True)
+    bank_c = MonitorBank(cfg, halt_on="fatal")
+    on_c = jax_backend.run(
+        cfg, ds, f_opt, measure_timestamps=True, monitors=bank_c
+    )
+    np.testing.assert_array_equal(
+        off_c.history.objective, on_c.history.objective
+    )
+    assert bank_c.anomalies == []
+
+
+def test_monitors_on_bitwise_batch():
+    cfg, ds, f_opt = _setup(straggler_prob=0.1)
+    off = jax_backend.run_batch(cfg.replace(replicas=3), ds, f_opt)
+    bank = MonitorBank(cfg, halt_on="fatal")
+    on = jax_backend.run_batch(
+        cfg.replace(replicas=3), ds, f_opt, monitors=bank,
+        progress_every=2,
+    )
+    np.testing.assert_array_equal(off.objective, on.objective)
+    for r in range(3):
+        np.testing.assert_array_equal(
+            off.results[r].final_models, on.results[r].final_models
+        )
+    assert bank.anomalies == []
+
+
+def test_monitors_on_bitwise_async():
+    cfg, ds, f_opt = _setup(
+        execution="async", latency_model="lognormal", latency_mean=1.0,
+        latency_tail=0.5,
+    )
+    off = jax_backend.run(cfg, ds, f_opt)
+    bank = MonitorBank(cfg, halt_on="fatal")
+    on = jax_backend.run(cfg, ds, f_opt, monitors=bank, progress_every=2)
+    np.testing.assert_array_equal(off.history.objective, on.history.objective)
+    np.testing.assert_array_equal(off.final_models, on.final_models)
+    assert bank.anomalies == [] and bank.halted_at is None
+
+
+def test_async_progress_segments_bitwise_and_fewer_syncs():
+    """The ISSUE-13 satellite: the async progress path executes fused
+    SEGMENTS of progress_every chunks (not a per-chunk host loop) and
+    stays bitwise the fused one-shot program."""
+    cfg, ds, f_opt = _setup(
+        execution="async", latency_model="exponential", latency_mean=1.0,
+    )
+    off = jax_backend.run(cfg, ds, f_opt)
+    events = []
+    on = jax_backend.run(
+        cfg, ds, f_opt, progress_cb=events.append, progress_every=4
+    )
+    np.testing.assert_array_equal(off.history.objective, on.history.objective)
+    # 4 eval chunks at progress_every=4 -> ONE heartbeat at the horizon.
+    assert [e.iteration for e in events] == [40]
+
+
+# ------------------------------------- planted f > b Byzantine run (e2e)
+
+
+def test_planted_overbudget_alie_fires_halts_and_names_attacker():
+    cfg = _diverging_config()
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+
+    # Full (unhalted) run: the reference trajectory + measured onset.
+    full = jax_backend.run(cfg, ds, f_opt)
+    gaps = full.history.objective
+    evals = full.history.eval_iterations
+    # Measured degradation onset: first eval where the gap exceeds the
+    # best gap seen so far (the run only ever gets worse after it).
+    best = np.minimum.accumulate(gaps)
+    degraded = np.flatnonzero(gaps[1:] > best[:-1])
+    measured_onset = int(evals[degraded[0] + 1])
+
+    bank = MonitorBank(cfg, halt_on="never")
+    jax_backend.run(cfg, ds, f_opt, monitors=bank)
+    div = [a for a in bank.anomalies if a.detector == "divergence"]
+    assert div, f"divergence did not fire; fired={bank.anomalies}"
+    onset = div[0].onset_iteration
+    assert abs(onset - measured_onset) <= 2 * cfg.eval_every, (
+        f"onset {onset} vs measured degradation {measured_onset}"
+    )
+
+    # halt_on=fatal: the run ends at the next chunk boundary with the
+    # executed prefix bitwise the full run's (partial result).
+    bank_h = MonitorBank(cfg, halt_on="fatal")
+    part = jax_backend.run(cfg, ds, f_opt, monitors=bank_h)
+    n_done = len(part.history.objective)
+    assert n_done < len(gaps), "halt_on=fatal did not end the run early"
+    assert bank_h.halted_at == n_done * cfg.eval_every
+    np.testing.assert_array_equal(part.history.objective, gaps[:n_done])
+    np.testing.assert_array_equal(
+        part.history.eval_iterations, evals[:n_done]
+    )
+    # The halted run bills only the executed iterations.
+    assert (
+        part.history.total_floats_transmitted
+        < full.history.total_floats_transmitted
+    )
+
+    # Incident forensics: the bundle names the attacker context.
+    incidents = bank_h.incidents(label="planted-alie")
+    inc = next(i for i in incidents if i["detector"] == "divergence")
+    attack = inc["context"]["attack"]
+    assert attack["attack"] == "alie"
+    assert attack["over_budget"] is True
+    assert attack["n_byzantine"] == 3 and attack["robust_b"] == 1
+    assert len(attack["byzantine_nodes"]) == 3
+    assert inc["structural_hash"] == cfg.structural_hash()
+    assert inc["evidence"]["gap"][-1] > inc["evidence"]["gap"][0]
+
+
+def test_fault_context_records_downtime_and_window_bhat():
+    cfg = small_config(
+        n_iterations=60, eval_every=10, mttf=8.0, mttr=4.0,
+    )
+    bank = MonitorBank(cfg)
+    anomaly = Anomaly("divergence", "fatal", 30, "synthetic", {})
+    inc = build_incident(cfg, anomaly, label="ctx")
+    faults = inc["context"]["faults"]
+    assert "window_bhat" in faults
+    assert isinstance(faults["nodes_down_at_onset"], list)
+    assert faults["n_nodes_down_at_onset"] >= 0
+    assert inc["context"]["window"] == [0, 60]
+    assert bank.halt_on == "never"
+
+
+# ---------------------------------------------------- forensics plumbing
+
+
+def test_incident_jsonl_roundtrip_and_observatory(tmp_path):
+    cfg = _diverging_config(n_iterations=200)
+    ds = generate_synthetic_dataset(cfg)
+    _, f_opt = compute_reference_optimum(ds, cfg.reg_param)
+    bank = MonitorBank(cfg)
+    jax_backend.run(cfg, ds, f_opt, monitors=bank)
+    assert bank.anomalies
+    out = incidents_path_for(tmp_path / "runs.jsonl")
+    assert out.name == "runs.incidents.jsonl"
+    write_incidents(out, bank.incidents(label="roundtrip"))
+    back = read_incidents(out)
+    assert len(back) == len(bank.anomalies)
+    assert back[0]["kind"] == "incident"
+
+    # Observatory index + filters.
+    recs = observatory.build_incident_index(tmp_path)
+    assert len(recs) == len(back)
+    assert recs[0].label == "roundtrip"
+    assert observatory.build_incident_index(
+        tmp_path, detector="divergence"
+    )
+    assert not observatory.build_incident_index(
+        tmp_path, severity="info"
+    )
+
+    # list --with-incidents joins counts onto the run index by config
+    # hash: write a matching RunTrace manifest next to the incidents.
+    from distributed_optimization_tpu import telemetry
+
+    run2 = jax_backend.run(cfg, ds, f_opt)
+    tr = telemetry.build_run_trace("roundtrip", cfg, run2.history)
+    telemetry.write_jsonl(tmp_path / "runs.jsonl", [tr])
+    counts = observatory.incident_counts(tmp_path)
+    assert counts.get(tr.config_hash) == len(back)
+    assert observatory.main(["incidents", str(tmp_path)]) == 0
+    assert observatory.main(
+        ["list", str(tmp_path), "--with-incidents"]
+    ) == 0
+
+    # compare: incident deltas between a clean and an incident-carrying
+    # manifest.
+    clean = tr.to_dict()
+    dirty = json.loads(json.dumps(clean))
+    dirty["health"] = {
+        "incidents": {
+            "count": 2, "fatal": 1, "halted_at": None,
+            "anomalies": [
+                {"detector": "divergence", "severity": "fatal",
+                 "onset_iteration": 40},
+                {"detector": "consensus_stall", "severity": "warn",
+                 "onset_iteration": 60},
+            ],
+        },
+    }
+    diff = observatory.compare_manifests(clean, dirty)
+    assert diff["incidents"]["delta"] == 2
+    assert diff["incidents"]["detectors_only_in_b"] == [
+        "consensus_stall", "divergence",
+    ]
+
+
+def test_serving_surfaces_incidents_status_stream_manifest():
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+
+    cfg = _diverging_config(n_iterations=300)
+    svc = SimulationService(
+        ServingOptions(window_s=0.0, progress_every=1),
+        cache=ExecutableCache(),
+    )
+    rid = svc.submit(cfg)
+    svc.drain()
+    req = svc.result(rid, timeout=120.0)
+    assert req.status == "done"
+    assert req.incidents, "serving monitors recorded no incidents"
+    sd = req.status_dict()
+    assert sd["incidents"][0]["detector"] == "divergence"
+    # The progress stream carries the anomaly event inline.
+    kinds = [e.get("kind") for e in req.progress.events()]
+    assert "anomaly" in kinds
+    # The manifest's health block records the full summary.
+    inc = req.manifest["health"]["incidents"]
+    assert inc["count"] >= 1
+    assert any(
+        a["detector"] == "divergence" for a in inc["anomalies"]
+    )
+    assert svc.stats()["incidents_total"] >= 1
+
+
+def test_serving_monitors_off_and_healthy_requests_clean():
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+
+    cfg = small_config(n_iterations=40, eval_every=10)
+    svc = SimulationService(
+        ServingOptions(window_s=0.0, monitors=False),
+        cache=ExecutableCache(),
+    )
+    rid = svc.submit(cfg)
+    svc.drain()
+    req = svc.result(rid, timeout=60.0)
+    assert req.status == "done" and req.incidents == []
+    assert "incidents" not in req.status_dict()
+    assert "incidents" not in req.manifest["health"]
+    # Monitors on, healthy run: still clean.
+    svc2 = SimulationService(
+        ServingOptions(window_s=0.0), cache=ExecutableCache(),
+    )
+    rid2 = svc2.submit(cfg)
+    svc2.drain()
+    req2 = svc2.result(rid2, timeout=60.0)
+    assert req2.status == "done" and req2.incidents == []
+    assert "incidents" not in req2.manifest["health"]
+
+
+def test_scenario_triage_mechanics():
+    from distributed_optimization_tpu.scenarios.engine import triage_cell
+
+    assert triage_cell([]) == "converged"
+    assert triage_cell(
+        [{"detector": "consensus_stall", "severity": "warn"}]
+    ) == "validly_degraded"
+    assert triage_cell(
+        [{"detector": "divergence", "severity": "fatal"}]
+    ) == "pathological"
+    assert triage_cell([], run_error="boom") == "pathological"
+
+
+def test_trace_scan_wired_into_backend():
+    """A telemetry run feeds the flight-recorder buffers to the bank's
+    trace detectors without any extra call at the call site."""
+    cfg, ds, f_opt = _setup(
+        telemetry=True, aggregation="trimmed_mean", robust_b=1,
+    )
+    seen = {}
+
+    class Probe(ScreeningSaturationDetector):
+        def _scan_trace(self, trace, eval_iterations):
+            seen["rows"] = len(np.asarray(trace["clip_frac"]))
+            seen["iters"] = np.asarray(eval_iterations).tolist()
+            return super()._scan_trace(trace, eval_iterations)
+
+    bank = MonitorBank(cfg, detectors=[Probe()])
+    jax_backend.run(cfg, ds, f_opt, monitors=bank)
+    assert seen["rows"] == 4 and seen["iters"] == [10, 20, 30, 40]

@@ -367,6 +367,9 @@ PERF_ARTIFACT_KEYS = {
         "metric", "protocol", "published_floor_ratio_vs_numpy",
         "published_range_ips", "range_derivation", "sessions_t300k",
         "sessions_t30k_superseded_protocol"},
+    "monitors.json": {
+        "device", "platform", "protocol", "note", "overhead", "async",
+        "divergence", "halt", "gates"},
     "observatory.json": {
         "device", "platform", "protocol", "note", "heartbeat", "async",
         "scrape", "gates"},
