@@ -3,11 +3,12 @@
 
 PY ?= python
 
-.PHONY: test smoke serve-smoke observatory-smoke scenarios-smoke perf-diff \
-	bench-byzantine bench-churn \
+.PHONY: test smoke serve-smoke serve-restart-smoke observatory-smoke \
+	scenarios-smoke perf-diff bench-byzantine bench-churn \
 	bench-robust-scale bench-sweep bench-compute bench-telemetry \
-	bench-fused bench-serving bench-federated bench-async \
-	bench-observatory bench-mesh bench-scenarios bench-monitors
+	bench-fused bench-serving bench-serving-load bench-federated \
+	bench-async bench-observatory bench-mesh bench-scenarios \
+	bench-monitors
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -32,6 +33,7 @@ smoke:
 		tests/test_scenarios.py tests/test_scenario_chaos.py
 	$(MAKE) observatory-smoke
 	$(MAKE) scenarios-smoke
+	$(MAKE) serve-restart-smoke
 
 # End-to-end scenario-engine smoke (docs/SCENARIOS.md): a seeded sample
 # over a mixed axis bank (validity agreement + per-cell invariants +
@@ -66,6 +68,13 @@ perf-diff:
 # responses match a direct run, shut down cleanly over the wire.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) examples/serve_smoke.py
+
+# Full-process restart over the persistent executable store (ISSUE-15
+# restart-warm gate): daemon A serves cold + writes through, SIGKILL,
+# daemon B over the same store replays with 0 compile seconds and a
+# bitwise-identical final gap.
+serve-restart-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/serve_restart_smoke.py
 
 # Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
 bench-byzantine:
@@ -127,6 +136,14 @@ bench-async:
 # container, mixed-workload replay stats, f64 parity re-check).
 bench-serving:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_serving.py
+
+# Regenerate the sustained-load serving evidence
+# (docs/perf/serving_load.json: scenario-sampled mixed traffic through
+# the multi-worker daemon + persistent store — warm p50/p99 latency,
+# saturation >= the PR-7 coalesced baseline, shed + fairness cells,
+# restart-warm ratio, worker-plane f64 parity).
+bench-serving-load:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_serving_load.py
 
 # Regenerate the live-observatory evidence (docs/perf/observatory.json:
 # heartbeat-on vs off steady-state overhead <= 3% ceiling + off/on

@@ -453,6 +453,27 @@ PERF_TOLERANCES: dict[str, tuple[Check, ...]] = {
         Check("throughput.speedup", rtol=0.6, direction="min"),
         Check("throughput.coalescing_loses", equal=True),
     ),
+    "serving_load.json": (
+        # The sustained-load plane (ISSUE-15): the boolean gates —
+        # restart replay 100% warm + bitwise over the persistent store,
+        # shed observed at the tenant cap, the honest saturation/
+        # fairness loses flags — must reproduce exactly; the wall-clock
+        # cells (warm p99, saturation req/s, victim fairness ratio) get
+        # generous envelopes because this shared CPU container's load
+        # varies 2-3x between sessions.
+        Check("gates.*", equal=True, bool_only=True),
+        Check("gates.parity_max_abs_deviation_f64",
+              rtol=1.0, atol_floor=1e-12, direction="max"),
+        Check("latency.warm_p99_s", rtol=2.0, direction="max",
+              atol_floor=1.0),
+        Check("saturation.requests_per_s", rtol=0.7, direction="min"),
+        Check("saturation.saturation_loses", equal=True),
+        Check("fairness.victim_p99_ratio", rtol=2.0, direction="max",
+              atol_floor=2.0),
+        Check("fairness.fairness_loses", equal=True),
+        Check("restart.warm_ratio", equal=True),
+        Check("restart.bitwise", equal=True),
+    ),
     "async.json": (
         Check("gates.*", equal=True, bool_only=True),
         Check("gates.jax_vs_numpy_per_event_parity_max_dev_f64",

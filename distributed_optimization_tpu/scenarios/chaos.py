@@ -15,6 +15,11 @@ asserts graceful degradation (ISSUE-12 part c). Modes:
   SAME executable cache must serve the re-submitted request WARM (zero
   compile seconds — the cache recovery the serving docs promise), and the
   retrying client must ride out the restart's connection failures.
+- ``store_restart``: a FULL process restart (ISSUE-15): daemon A compiles
+  cold and writes through to a persistent executable store; daemon B gets
+  a FRESH in-memory cache — nothing but the store directory survives —
+  and must serve the same structural class warm (zero compile seconds,
+  the entry demonstrably loaded from disk, final gap bitwise equal).
 - ``truncated_checkpoint``: the latest checkpoint chunk of an interrupted
   run is gutted mid-save-style; resume must warn, fall back to the last
   intact chunk, and still end BITWISE where the uninterrupted
@@ -50,8 +55,8 @@ from distributed_optimization_tpu.observability.metrics_registry import (
 _log = get_logger("scenarios.chaos")
 
 CHAOS_MODES = (
-    "poisoned_cohort", "daemon_kill_restart", "truncated_checkpoint",
-    "broken_progress_callback",
+    "poisoned_cohort", "daemon_kill_restart", "store_restart",
+    "truncated_checkpoint", "broken_progress_callback",
 )
 
 
@@ -255,6 +260,107 @@ def push_seed(seed: int) -> int:
     return seed + 101
 
 
+def chaos_store_restart(
+    *, config: Optional[ExperimentConfig] = None,
+    store_root: Optional[str] = None,
+) -> ChaosRecord:
+    """Full process restart: NOTHING in memory survives. Daemon A
+    compiles cold through a write-through persistent store; daemon B is
+    built over a FRESH ``ExecutableCache`` whose only warm tier is the
+    store directory on disk, and must serve the same structural class
+    with zero compile seconds and a bitwise-equal final gap."""
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.client import RetryingClient
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+    from distributed_optimization_tpu.serving.store import (
+        PersistentExecutableStore,
+    )
+
+    # A structural class the other chaos modes do NOT compile, so the
+    # disk store is provably this mode's only warm path.
+    cfg = config or default_chaos_config(n_iterations=90)
+    own_dir = store_root is None
+    root = store_root or tempfile.mkdtemp(prefix="dopt-chaos-store-")
+    detail: dict[str, Any] = {"store_root": root}
+    passed = False
+    try:
+        # --- incarnation A: cold compile, write-through to disk ---------
+        daemon_a = ServingDaemon(
+            "127.0.0.1", 0,
+            service=SimulationService(
+                ServingOptions(window_s=0.0),
+                cache=ExecutableCache(store=PersistentExecutableStore(root)),
+            ),
+        )
+        daemon_a.start()
+        client = RetryingClient(daemon_a.url, max_retries=8,
+                                backoff_s=0.05, seed=0)
+        code, first = client.run(cfg.to_dict(), timeout=300.0)
+        detail["first_run_status"] = code
+        detail["first_compile_seconds"] = (
+            first.get("compile_seconds") if isinstance(first, dict) else None
+        )
+        first_gap = (
+            (first.get("health") or {}).get("final_gap")
+            if isinstance(first, dict) else None
+        )
+        daemon_a.stop()  # the whole incarnation dies, cache memory included
+
+        # --- incarnation B: fresh cache, same store directory -----------
+        cache_b = ExecutableCache(store=PersistentExecutableStore(root))
+        daemon_b = ServingDaemon(
+            "127.0.0.1", 0,
+            service=SimulationService(
+                ServingOptions(window_s=0.0), cache=cache_b,
+            ),
+        )
+        daemon_b.start()
+        try:
+            client_b = RetryingClient(daemon_b.url, max_retries=8,
+                                      backoff_s=0.05, seed=1)
+            code, again = client_b.run(cfg.to_dict(), timeout=300.0)
+            detail["restart_run_status"] = code
+            serving = (
+                (again.get("health") or {}).get("serving")
+                if isinstance(again, dict) else None
+            ) or {}
+            detail["restart_cache_hit"] = serving.get("cache_hit")
+            detail["restart_compile_seconds"] = (
+                again.get("compile_seconds")
+                if isinstance(again, dict) else None
+            )
+            again_gap = (
+                (again.get("health") or {}).get("final_gap")
+                if isinstance(again, dict) else None
+            )
+            detail["final_gap_bitwise"] = (
+                first_gap is not None and first_gap == again_gap
+            )
+            store_stats = (cache_b.stats().get("store") or {})
+            detail["store_load_hits"] = store_stats.get("load_hits")
+            passed = (
+                detail["first_run_status"] == 200
+                and detail["restart_run_status"] == 200
+                # Warm across the restart, and warm FROM DISK: the fresh
+                # cache's entry came through the store's load path.
+                and detail["restart_cache_hit"] is True
+                and detail["restart_compile_seconds"] == 0.0
+                and (detail["store_load_hits"] or 0) >= 1
+                and detail["final_gap_bitwise"]
+            )
+        finally:
+            daemon_b.stop()
+    finally:
+        if own_dir:
+            shutil.rmtree(root, ignore_errors=True)
+    _chaos_gauge().set(1, mode="store_restart")
+    return ChaosRecord("store_restart", passed, detail)
+
+
 def chaos_truncated_checkpoint(
     *, config: Optional[ExperimentConfig] = None,
     workdir: Optional[str] = None,
@@ -395,6 +501,7 @@ def run_chaos_suite(
         "daemon_kill_restart": lambda: chaos_daemon_kill_restart(
             config=config
         ),
+        "store_restart": lambda: chaos_store_restart(config=config),
         "truncated_checkpoint": lambda: chaos_truncated_checkpoint(
             config=config
         ),

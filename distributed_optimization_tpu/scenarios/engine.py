@@ -60,6 +60,42 @@ class EngineRunError(RuntimeError):
     structured error message."""
 
 
+def expand_cell_requests(cfg: ExperimentConfig) -> list[ExperimentConfig]:
+    """A cell config's serving requests: itself, or the R-replica seed
+    expansion with dataset + random-graph pinned so the coalescer can
+    reassemble the cohort. Shared by the engine's run loop and the
+    sustained-load traffic sampler below — one definition of how a
+    sampled cell becomes submit-ready traffic."""
+    if cfg.replicas == 1:
+        return [cfg]
+    pins: dict[str, Any] = {
+        "replicas": 1, "data_seed": cfg.resolved_data_seed(),
+    }
+    if cfg.topology in RANDOM_TOPOLOGIES:
+        pins["topology_seed"] = cfg.resolved_topology_seed()
+    return [
+        cfg.replace(seed=seed, **pins) for seed in cfg.replica_seeds()
+    ]
+
+
+def sample_traffic(
+    spec: ScenarioSpec, *, limit: Optional[int] = None,
+) -> list[ExperimentConfig]:
+    """Serving traffic from a scenario spec (ISSUE-15): every valid
+    sampled cell expanded into its submit-ready requests, in sample
+    order — the mixed-cohort structural-class stream the sustained-load
+    bench (``examples/bench_serving_load.py``) replays at rate. The
+    spec's seed makes the stream reproducible; ``limit`` truncates it."""
+    sample = generate(spec)
+    out: list[ExperimentConfig] = []
+    for cell in sample.valid_cells:
+        assert cell.config is not None
+        out.extend(expand_cell_requests(cell.config))
+        if limit is not None and len(out) >= limit:
+            return out[:limit]
+    return out
+
+
 def triage_cell(incidents, run_error=None) -> str:
     """Mechanical cell triage (ISSUE-13): sweeps separate 'converged'
     (no anomaly fired), 'validly_degraded' (warn-severity incidents only
@@ -222,21 +258,9 @@ class ScenarioEngine:
 
     # ------------------------------------------------------------- running
     def _expand(self, cell: Cell) -> list[ExperimentConfig]:
-        """A cell's serving requests: itself, or the R-replica seed
-        expansion with dataset + random-graph pinned so the coalescer can
-        reassemble the cohort."""
-        cfg = cell.config
-        assert cfg is not None
-        if cfg.replicas == 1:
-            return [cfg]
-        pins: dict[str, Any] = {
-            "replicas": 1, "data_seed": cfg.resolved_data_seed(),
-        }
-        if cfg.topology in RANDOM_TOPOLOGIES:
-            pins["topology_seed"] = cfg.resolved_topology_seed()
-        return [
-            cfg.replace(seed=seed, **pins) for seed in cfg.replica_seeds()
-        ]
+        """A cell's serving requests (see ``expand_cell_requests``)."""
+        assert cell.config is not None
+        return expand_cell_requests(cell.config)
 
     def run(self) -> dict[str, Any]:
         t0 = time.perf_counter()

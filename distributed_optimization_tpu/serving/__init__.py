@@ -20,6 +20,20 @@ Turns the simulator into a request-driven service built from three layers:
   (``python -m distributed_optimization_tpu.serve``) that takes config JSON
   in and streams ``RunTrace`` manifests back.
 
+ISSUE-15 grew the production plane on top:
+
+- ``serving.store`` — the persistent executable store: compiled programs
+  serialized to disk (jax AOT executable serialization) under provenance
+  guards, so a daemon restart serves previously-compiled structural
+  classes with 0 compile seconds (``DOPT_EXEC_STORE=<dir>`` /
+  ``--store``).
+- ``serving.admission`` — per-tenant weighted-fair scheduling (deficit
+  round robin over (tenant, priority) sub-queues), per-tenant depth
+  caps, shed-load 429s.
+- ``serving.workers`` — N spawned worker processes executing cohorts
+  concurrently, health-checked with bounded requeue; the store is their
+  shared warm tier.
+
 This ``__init__`` stays import-light on purpose: ``backends/jax_backend``
 imports ``serving.cache`` at module load, so pulling the service/daemon
 (and through them the backends) in here would be a cycle.
@@ -35,6 +49,12 @@ _LAZY = {
     "ServingError": "distributed_optimization_tpu.serving.service",
     "ServingOptions": "distributed_optimization_tpu.serving.service",
     "ServingDaemon": "distributed_optimization_tpu.serving.daemon",
+    "PersistentExecutableStore": "distributed_optimization_tpu.serving.store",
+    "process_executable_store": "distributed_optimization_tpu.serving.store",
+    "WeightedFairQueue": "distributed_optimization_tpu.serving.admission",
+    "ShedLoad": "distributed_optimization_tpu.serving.admission",
+    "WorkerPool": "distributed_optimization_tpu.serving.workers",
+    "RetryingClient": "distributed_optimization_tpu.serving.client",
 }
 
 __all__ = sorted(_LAZY)

@@ -1,0 +1,241 @@
+"""Admission control + per-tenant weighted-fair scheduling (ISSUE-15).
+
+PR 7's service kept one FIFO list with one global bound — fine for a
+bench harness, hostile for a service: a single tenant scripting 1000
+submits owns the queue, every other tenant's requests age behind it, and
+the only defense is the global 429. This module replaces that list with
+a **deficit-round-robin (DRR) scheduler over per-(tenant, priority)
+sub-queues**:
+
+- Every request lands in the sub-queue for its ``(tenant, priority)``
+  pair (tenant defaults to ``"default"``, priority to ``"normal"``).
+- ``cut(budget)`` visits sub-queues round-robin; each visit adds the
+  entity's quantum — tenant weight × priority multiplier — to its
+  deficit and dequeues whole requests while deficit allows. An
+  adversarial tenant with 1000 queued requests still only drains at its
+  weight's share per cut, so a victim tenant's requests reach the
+  scheduler within one round regardless of backlog (starvation-free;
+  tests/test_admission.py pins the fairness ratio end to end).
+- Admission: a full per-tenant depth cap or global cap raises
+  ``ShedLoad`` with a machine-readable reason; the daemon maps it to the
+  same 429 + Retry-After contract the global bound already spoke
+  (``RetryingClient`` retries it transparently), and every shed
+  increments ``dopt_serving_shed_total{reason,tenant}``.
+
+All requests in one cut still flow to the SAME coalescer pass, so
+cross-tenant requests of one structural class share a cohort — fairness
+governs queueing order, never splits compatible work.
+
+Deliberately jax-free and service-free: pure data structure + policy,
+unit-testable without a daemon.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict, deque
+from typing import Optional
+
+# Priority classes scale the tenant's DRR quantum. "high" drains 4× the
+# requests per round of "normal"; "low" is background traffic that only
+# fills otherwise-idle budget. Class membership never preempts — it is a
+# bandwidth share, so "low" still progresses every round (no starvation).
+PRIORITY_MULTIPLIERS = {"high": 4.0, "normal": 1.0, "low": 0.25}
+DEFAULT_TENANT = "default"
+DEFAULT_PRIORITY = "normal"
+
+# Tenant names become metric label values and JSON keys; constrain them
+# so a hostile name cannot inject exposition-format syntax or balloon
+# the label set with unbounded garbage.
+_TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]{0,63}$")
+
+
+class AdmissionError(ValueError):
+    """Rejected before queueing for a malformed tenant/priority field —
+    the daemon maps it to a structured 400."""
+
+
+class ShedLoad(RuntimeError):
+    """Admission refused for load reasons — the daemon maps it to a 429
+    with Retry-After (the bounded-queue contract RetryingClient already
+    retries)."""
+
+    def __init__(self, reason: str, tenant: str, detail: str):
+        super().__init__(detail)
+        self.reason = reason  # "tenant_cap" | "global_cap"
+        self.tenant = tenant
+
+
+def validate_tenant(tenant: Optional[str]) -> str:
+    if tenant is None:
+        return DEFAULT_TENANT
+    if not isinstance(tenant, str) or not _TENANT_RE.match(tenant):
+        raise AdmissionError(
+            "tenant must be 1-64 chars of [A-Za-z0-9_.-] starting "
+            f"alphanumeric, got {tenant!r}"
+        )
+    return tenant
+
+
+def validate_priority(priority: Optional[str]) -> str:
+    if priority is None:
+        return DEFAULT_PRIORITY
+    if priority not in PRIORITY_MULTIPLIERS:
+        raise AdmissionError(
+            f"priority must be one of {sorted(PRIORITY_MULTIPLIERS)}, "
+            f"got {priority!r}"
+        )
+    return priority
+
+
+class WeightedFairQueue:
+    """DRR scheduler over per-(tenant, priority) sub-queues.
+
+    Thread-safe. ``push`` admits or sheds; ``cut`` dequeues up to
+    ``budget`` requests fairly; ``depths``/``stats`` feed the gauges.
+    One quantum unit == one request (requests are near-uniform cost at
+    admission time — cohort cost forms only after coalescing), so weights
+    read directly as requests-per-round ratios.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_pending: int,
+        max_pending_per_tenant: Optional[int] = None,
+        tenant_weights: Optional[dict] = None,
+    ):
+        if max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1, got {max_pending}")
+        if max_pending_per_tenant is not None and max_pending_per_tenant < 1:
+            raise ValueError(
+                "max_pending_per_tenant must be >= 1, got "
+                f"{max_pending_per_tenant}")
+        self.max_pending = max_pending
+        self.max_pending_per_tenant = max_pending_per_tenant
+        self.tenant_weights = dict(tenant_weights or {})
+        for t, w in self.tenant_weights.items():
+            if not (float(w) > 0.0):
+                raise ValueError(
+                    f"tenant weight must be > 0, got {t}={w!r}")
+        self._lock = threading.Lock()
+        # Sub-queues in first-seen order; OrderedDict is the DRR ring
+        # (rotation = move_to_end). Entities persist across cuts so
+        # deficits carry — that carry is what makes DRR exact over time.
+        self._queues: "OrderedDict[tuple, deque]" = OrderedDict()
+        self._deficits: dict[tuple, float] = {}
+        self._total = 0
+        self.admitted = 0
+        self.dispatched = 0
+        self.shed = 0
+
+    # ------------------------------------------------------------ admission
+    def _quantum(self, entity: tuple) -> float:
+        tenant, priority = entity
+        weight = float(self.tenant_weights.get(tenant, 1.0))
+        return weight * PRIORITY_MULTIPLIERS[priority]
+
+    def push(self, request, *, tenant: str, priority: str) -> None:
+        """Admit one request or raise ``ShedLoad``.
+
+        The per-tenant cap is checked before the global one so a tenant
+        at its own cap is named as the reason even when the queue is also
+        globally full — the client-visible reason should blame the actor
+        that can fix it.
+        """
+        entity = (tenant, priority)
+        with self._lock:
+            if self.max_pending_per_tenant is not None:
+                tenant_depth = sum(
+                    len(q) for (t, _), q in self._queues.items()
+                    if t == tenant
+                )
+                if tenant_depth >= self.max_pending_per_tenant:
+                    self.shed += 1
+                    raise ShedLoad(
+                        "tenant_cap", tenant,
+                        f"tenant {tenant!r} has {tenant_depth} pending "
+                        f"requests (cap {self.max_pending_per_tenant})",
+                    )
+            if self._total >= self.max_pending:
+                self.shed += 1
+                raise ShedLoad(
+                    "global_cap", tenant,
+                    f"queue full ({self._total} pending, cap "
+                    f"{self.max_pending})",
+                )
+            q = self._queues.get(entity)
+            if q is None:
+                q = deque()
+                self._queues[entity] = q
+                self._deficits[entity] = 0.0
+            q.append(request)
+            self._total += 1
+            self.admitted += 1
+
+    # ----------------------------------------------------------- scheduling
+    def cut(self, budget: Optional[int] = None) -> list:
+        """Dequeue up to ``budget`` requests (all pending when None),
+        weighted-fair across entities, FIFO within each entity.
+
+        Classic DRR: visit entities in ring order; each visit grants the
+        entity its quantum of deficit, which it spends on whole requests.
+        Entities emptied mid-round drop out of the ring (their deficit
+        resets — carrying credit for an empty queue would let an idle
+        tenant burst past its share later).
+        """
+        out: list = []
+        with self._lock:
+            if budget is None:
+                budget = self._total
+            if budget <= 0 or self._total == 0:
+                return out
+            # Bound the number of ring sweeps: with the smallest quantum
+            # q_min, one request costs at most ceil(1/q_min) visits.
+            while len(out) < budget and self._queues:
+                for entity in list(self._queues.keys()):
+                    if len(out) >= budget:
+                        break
+                    q = self._queues[entity]
+                    self._deficits[entity] += self._quantum(entity)
+                    while q and self._deficits[entity] >= 1.0 and (
+                        len(out) < budget
+                    ):
+                        out.append(q.popleft())
+                        self._deficits[entity] -= 1.0
+                        self._total -= 1
+                        self.dispatched += 1
+                    if not q:
+                        del self._queues[entity]
+                        del self._deficits[entity]
+                    else:
+                        self._queues.move_to_end(entity)
+        return out
+
+    # ------------------------------------------------------------ inventory
+    def __len__(self) -> int:
+        with self._lock:
+            return self._total
+
+    def depths(self) -> dict[str, int]:
+        """Pending depth per tenant (summed over priorities) — the
+        per-tenant gauge family's source of truth."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for (tenant, _), q in self._queues.items():
+                out[tenant] = out.get(tenant, 0) + len(q)
+            return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "pending": self._total,
+                "admitted": int(self.admitted),
+                "dispatched": int(self.dispatched),
+                "shed": int(self.shed),
+                "tenants": len({t for t, _ in self._queues}),
+                "max_pending": self.max_pending,
+                "max_pending_per_tenant": self.max_pending_per_tenant,
+            }

@@ -106,6 +106,7 @@ class ExecutableCache:
         self,
         max_entries: int = DEFAULT_MAX_ENTRIES,
         max_bytes: int = DEFAULT_MAX_BYTES,
+        store=None,
     ):
         if max_entries < 1:
             raise ValueError(f"max_entries must be >= 1, got {max_entries}")
@@ -113,11 +114,17 @@ class ExecutableCache:
             raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
         self.max_entries = max_entries
         self.max_bytes = max_bytes
+        # Optional persistent disk tier (serving/store.py, ISSUE-15):
+        # get() falls through to it on a memory miss, put() writes
+        # through to it — both outside the cache lock (disk I/O and
+        # executable deserialization must not serialize lookups).
+        self.store = store
         self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
         self._lock = threading.RLock()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        self.store_hits = 0
         self.evictions = 0
         self.compile_seconds_saved = 0.0
         # Registry instrumentation (ISSUE-10): every cache instance feeds
@@ -145,46 +152,55 @@ class ExecutableCache:
     def get(self, key: tuple) -> Optional[CacheEntry]:
         """Look up a compiled program; counts a hit or a miss either way.
 
+        On a memory miss, falls through to the persistent store tier
+        (when one is attached): a store hit deserializes the executable,
+        promotes it into memory, and counts as a hit AND a ``store_hit``
+        — callers see exactly the contract a memory hit gives them
+        (``compile_seconds == 0.0`` on the reuse path), which is what the
+        restart-warm gate measures.
+
         Registry counters are bumped AFTER the cache lock is released:
         the registry's render/snapshot path calls back into the cache
         (the entries/bytes gauges) while holding the registry lock, so
         touching the registry while holding the cache lock would be the
         classic ABBA deadlock against a concurrent ``/metrics`` scrape.
+        Store I/O (disk read + executable load) happens outside the lock
+        too — a multi-ms deserialize must not serialize other lookups.
         """
         with self._lock:
             entry = self._entries.get(key)
-            if entry is None:
-                self.misses += 1
-            else:
+            if entry is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
                 entry.hits += 1
                 self.compile_seconds_saved += entry.compile_seconds
-        if entry is None:
-            self._m_misses.inc()
-        else:
+        if entry is not None:
             self._m_hits.inc()
             self._m_saved.inc(entry.compile_seconds)
-        return entry
+            return entry
+        loaded = self.store.load(key) if self.store is not None else None
+        if loaded is None:
+            with self._lock:
+                self.misses += 1
+            self._m_misses.inc()
+            return None
+        # Store hit: promote into memory (no write-back — it came from
+        # disk) and account it as a hit the moment it is served.
+        n_evicted = self._insert(key, loaded)
+        with self._lock:
+            self.hits += 1
+            self.store_hits += 1
+            loaded.hits += 1
+            self.compile_seconds_saved += loaded.compile_seconds
+        if n_evicted:
+            self._m_evictions.inc(n_evicted)
+        self._m_hits.inc()
+        self._m_saved.inc(loaded.compile_seconds)
+        return loaded
 
-    def put(
-        self,
-        key: tuple,
-        executable,
-        *,
-        cost: Optional[dict] = None,
-        compile_seconds: float = 0.0,
-    ) -> CacheEntry:
-        """Insert a freshly compiled program, evicting LRU entries past the
-        count/bytes bounds (the newest entry itself is never evicted — an
-        oversized program simply owns the cache until something replaces
-        it)."""
-        entry = CacheEntry(
-            executable=executable,
-            cost=cost,
-            compile_seconds=float(compile_seconds),
-            est_bytes=estimate_executable_bytes(executable),
-        )
+    def _insert(self, key: tuple, entry: CacheEntry) -> int:
+        """Insert under the lock with LRU eviction; returns the eviction
+        count for the caller to report outside the lock (see get())."""
         n_evicted = 0
         with self._lock:
             old = self._entries.pop(key, None)
@@ -199,8 +215,34 @@ class ExecutableCache:
                 self._bytes -= evicted.est_bytes
                 self.evictions += 1
                 n_evicted += 1
+        return n_evicted
+
+    def put(
+        self,
+        key: tuple,
+        executable,
+        *,
+        cost: Optional[dict] = None,
+        compile_seconds: float = 0.0,
+    ) -> CacheEntry:
+        """Insert a freshly compiled program, evicting LRU entries past the
+        count/bytes bounds (the newest entry itself is never evicted — an
+        oversized program simply owns the cache until something replaces
+        it). Write-through: when a persistent store is attached, the new
+        program is serialized to disk so a future process starts warm."""
+        entry = CacheEntry(
+            executable=executable,
+            cost=cost,
+            compile_seconds=float(compile_seconds),
+            est_bytes=estimate_executable_bytes(executable),
+        )
+        n_evicted = self._insert(key, entry)
         if n_evicted:  # outside the cache lock — see get()
             self._m_evictions.inc(n_evicted)
+        if self.store is not None:
+            # Outside the lock: serialization is slow and never
+            # load-bearing (save() degrades to a warning on failure).
+            self.store.save(key, entry)
         return entry
 
     def clear(self) -> None:
@@ -208,8 +250,19 @@ class ExecutableCache:
             self._entries.clear()
             self._bytes = 0
 
+    def attach_store(self, store) -> None:
+        """Attach (or replace) the persistent disk tier after
+        construction — how the daemon wires ``--store`` into the
+        process-wide default cache."""
+        self.store = store
+
     def stats(self) -> dict:
-        """Counters for the serving telemetry block (all plain scalars)."""
+        """Counters for the serving telemetry block (all plain scalars
+        except ``store``, which is the attached store's own stats dict or
+        None — the key is ALWAYS present so the status shape does not
+        depend on deployment)."""
+        store = self.store
+        store_stats = store.stats() if store is not None else None
         with self._lock:
             lookups = self.hits + self.misses
             return {
@@ -217,9 +270,11 @@ class ExecutableCache:
                 "est_bytes": int(self._bytes),
                 "hits": int(self.hits),
                 "misses": int(self.misses),
+                "store_hits": int(self.store_hits),
                 "evictions": int(self.evictions),
                 "hit_rate": self.hits / lookups if lookups else None,
                 "compile_seconds_saved": float(self.compile_seconds_saved),
+                "store": store_stats,
             }
 
     @classmethod
@@ -254,7 +309,17 @@ def process_executable_cache() -> Optional[ExecutableCache]:
     global _process_cache
     with _process_lock:
         if _process_cache is None:
-            _process_cache = ExecutableCache()
+            # ``DOPT_EXEC_STORE=<dir>`` attaches the persistent disk tier
+            # (serving/store.py) to the process default — the env-var
+            # form is what spawned serving workers inherit, so every
+            # worker shares one warm store with zero plumbing.
+            from distributed_optimization_tpu.serving.store import (
+                process_executable_store,
+            )
+
+            _process_cache = ExecutableCache(
+                store=process_executable_store()
+            )
             # Scrape-time gauges for the process cache's current state
             # (entries/bytes are someone's source of truth, not events —
             # the registry polls them so they can never go stale).

@@ -145,8 +145,20 @@ class RetryingClient:
         )
 
     # ---------------------------------------------------------- endpoints
-    def submit(self, config: dict, timeout: Optional[float] = None):
-        return self.request("POST", "/v1/submit", config, timeout)
+    def submit(
+        self, config: dict, timeout: Optional[float] = None,
+        *, tenant: Optional[str] = None, priority: Optional[str] = None,
+    ):
+        body = config
+        if tenant is not None or priority is not None:
+            # The wrapped form carries the admission fields (ISSUE-15);
+            # a pre-wrapped body passes through untouched.
+            body = dict(config) if "config" in config else {"config": config}
+            if tenant is not None:
+                body["tenant"] = tenant
+            if priority is not None:
+                body["priority"] = priority
+        return self.request("POST", "/v1/submit", body, timeout)
 
     def run(self, config: dict, timeout: Optional[float] = None):
         # The socket timeout gets headroom over the server's long-poll
@@ -167,8 +179,20 @@ class RetryingClient:
     def status(self, timeout: Optional[float] = None):
         return self.request("GET", "/v1/status", None, timeout)
 
-    def shutdown(self, timeout: Optional[float] = None):
-        return self.request("POST", "/v1/shutdown", None, timeout)
+    def shutdown(
+        self, timeout: Optional[float] = None, *, drain: bool = False,
+        deadline: Optional[float] = None,
+    ):
+        path = "/v1/shutdown"
+        if drain:
+            path += "?drain=1"
+            if deadline is not None:
+                path += f"&deadline={deadline:g}"
+            if timeout is None:
+                # The server holds the request open while it drains;
+                # give the socket headroom over the drain deadline.
+                timeout = (deadline or 30.0) + 30.0
+        return self.request("POST", path, None, timeout)
 
     def metrics_text(self, timeout: Optional[float] = None) -> str:
         """GET /metrics (Prometheus text, not JSON). Same retry policy

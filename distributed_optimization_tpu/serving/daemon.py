@@ -31,6 +31,15 @@ encoding, so ``jq``/``JSON.parse`` read them even for divergent runs):
   exposition format (cache, coalescer, queue, progress, async-staleness
   families; one consistent snapshot per scrape).
 - ``POST /v1/shutdown`` — drain nothing, stop accepting, exit cleanly.
+  ``?drain=1[&deadline=S]`` (ISSUE-15) drains gracefully instead: new
+  submissions get 503 while queued + in-flight cohorts finish (bounded
+  by the deadline, default 30 s), then the daemon exits; the response
+  reports ``drained: true/false``.
+
+Admission (ISSUE-15): the wrapped submit form ``{"config": {...},
+"tenant": "acme", "priority": "high"}`` tags the request for the
+weighted-fair scheduler; per-tenant caps shed with 429 + a machine-
+readable reason. Bare config bodies run as tenant "default".
 """
 
 from __future__ import annotations
@@ -45,6 +54,7 @@ from distributed_optimization_tpu.log import get_logger
 from distributed_optimization_tpu.serving.service import (
     DONE,
     FAILED,
+    DrainingError,
     QueueFullError,
     ServingError,
     ServingOptions,
@@ -128,9 +138,12 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, code: int, error: str, detail: str = "") -> None:
         self._send(code, {"error": error, "detail": detail})
 
-    def _read_config(self) -> Optional[dict]:
-        """Parse the request body into a config dict, or answer 400 and
-        return None. Structured errors, never a dead connection."""
+    def _read_config(self) -> Optional[tuple]:
+        """Parse the request body into ``(config_dict, tenant, priority)``,
+        or answer 400 and return None. Structured errors, never a dead
+        connection. The admission fields ride the WRAPPED form only —
+        ``{"config": {...}, "tenant": "...", "priority": "..."}`` — so a
+        bare config object stays exactly the PR-7 protocol."""
         try:
             length = int(self.headers.get("Content-Length", "0"))
         except ValueError:
@@ -153,18 +166,22 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             self._error(400, "malformed_json", str(e))
             return None
+        tenant = priority = None
         if isinstance(payload, dict) and isinstance(
             payload.get("config"), dict
         ):
+            tenant = payload.get("tenant")
+            priority = payload.get("priority")
             payload = payload["config"]
         if not isinstance(payload, dict):
             self._error(
                 400, "invalid_request",
                 "body must be a JSON object of ExperimentConfig fields "
-                "(optionally wrapped as {\"config\": {...}})",
+                "(optionally wrapped as {\"config\": {...}, "
+                "\"tenant\": ..., \"priority\": ...})",
             )
             return None
-        return payload
+        return payload, tenant, priority
 
     def _query(self) -> dict:
         return parse_qs(urlparse(self.path).query)
@@ -196,22 +213,56 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path.rstrip("/")
         service = self.server.service
         if path == "/v1/shutdown":
-            self._send(200, {"status": "shutting_down"})
+            q = self._query()
+            if q.get("drain", ["0"])[0] in ("1", "true", "yes"):
+                # Graceful drain (ISSUE-15 satellite): refuse new
+                # submissions (503), finish queued + in-flight cohorts
+                # within the deadline, then exit. The response reports
+                # whether the drain actually emptied the service so
+                # operators can tell a clean stop from a deadline kill.
+                try:
+                    deadline = float(q.get("deadline", ["30"])[0])
+                except ValueError:
+                    deadline = 30.0
+                service.begin_drain()
+                drained = service.wait_drained(timeout=deadline)
+                self._send(200, {
+                    "status": "shutting_down",
+                    "drained": drained,
+                })
+            else:
+                # The PR-7 default, unchanged: drain nothing, stop now.
+                self._send(200, {"status": "shutting_down"})
             self.server.initiate_shutdown()
             return
         if path not in ("/v1/submit", "/v1/run"):
             self._error(404, "unknown_endpoint", path)
             return
-        payload = self._read_config()
-        if payload is None:
+        parsed = self._read_config()
+        if parsed is None:
             return
+        payload, tenant, priority = parsed
         try:
-            request_id = service.submit(payload)
+            request_id = service.submit(
+                payload, tenant=tenant, priority=priority
+            )
         except QueueFullError as e:
             # Backpressure is retryable server state, not a bad request —
             # a distinct status so clients can implement retry without
-            # string-matching the detail.
-            self._error(429, "queue_full", str(e))
+            # string-matching the detail. Shed-load rejections carry the
+            # admission reason + tenant for dashboards and tests.
+            self._send(429, {
+                "error": "queue_full",
+                "detail": str(e),
+                "reason": e.reason,
+                "tenant": e.tenant,
+            })
+            return
+        except DrainingError as e:
+            # Retryable by the client contract — the drain precedes a
+            # restart that will take the retry. Must be checked before
+            # ServingError (it IS one).
+            self._error(503, "draining", str(e))
             return
         except ServingError as e:
             # The structured rejection (config validation message included)
@@ -410,7 +461,26 @@ def main(argv=None) -> int:
     p.add_argument("--max-cohort", type=int, default=32,
                    help="replica-axis cap per coalesced run_batch call")
     p.add_argument("--max-pending", type=int, default=1024,
-                   help="queue bound; submits beyond it get a 400")
+                   help="queue bound; submits beyond it get a 429")
+    p.add_argument("--max-pending-per-tenant", type=int, default=None,
+                   help="per-tenant queue depth cap; a tenant at its cap "
+                        "gets shed-load 429s (reason=tenant_cap) while "
+                        "other tenants keep submitting")
+    p.add_argument("--cut-budget", type=int, default=None,
+                   help="max requests per scheduler cut (weighted-fair "
+                        "across tenants); default: everything pending")
+    p.add_argument("--workers", type=int, default=0,
+                   help="worker processes for cohort execution (0 = run "
+                        "on the scheduler thread); the persistent store "
+                        "is their shared warm tier")
+    p.add_argument("--store", default=None,
+                   help="persistent executable store directory: compiled "
+                        "programs are serialized there and reloaded "
+                        "across daemon restarts (0 compile seconds for "
+                        "previously-served structural classes)")
+    p.add_argument("--port-file", default=None,
+                   help="write the bound host:port here once listening "
+                        "(for --port 0 orchestration: benches, smokes)")
     p.add_argument("--socket-timeout", type=float,
                    default=DEFAULT_SOCKET_TIMEOUT_S,
                    help="per-connection socket timeout in seconds; a "
@@ -426,9 +496,21 @@ def main(argv=None) -> int:
 
     configure_logging(1 if args.verbose else (-1 if args.quiet else 0))
     if args.platform != "auto":
+        import os as os_mod
+
+        # The env form (not jax.config.update) so spawned worker
+        # processes inherit the pin before THEIR jax initializes.
+        os_mod.environ["JAX_PLATFORMS"] = args.platform
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.store:
+        # The env var is the single wiring point for the persistent
+        # store: the parent's process cache attaches it on first use,
+        # and spawned workers inherit it — one shared warm tier.
+        import os as os_mod
+
+        os_mod.environ["DOPT_EXEC_STORE"] = args.store
 
     daemon = ServingDaemon(
         args.host, args.port,
@@ -436,9 +518,20 @@ def main(argv=None) -> int:
             window_s=args.window_ms / 1000.0,
             max_cohort=args.max_cohort,
             max_pending=args.max_pending,
+            max_pending_per_tenant=args.max_pending_per_tenant,
+            cut_budget=args.cut_budget,
+            workers=args.workers,
         ),
         socket_timeout_s=args.socket_timeout,
     )
+    if args.port_file:
+        host, port = daemon.address
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{host}:{port}\n")
+        import os as os_mod
+
+        os_mod.replace(tmp, args.port_file)  # atomic: readers never see ""
     try:
         daemon.serve_forever()
     except KeyboardInterrupt:

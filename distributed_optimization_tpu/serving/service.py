@@ -46,8 +46,18 @@ from distributed_optimization_tpu.observability.progress import (
     ProgressStream,
 )
 from distributed_optimization_tpu.observability.spans import Tracer
+from distributed_optimization_tpu.serving.admission import (
+    DEFAULT_PRIORITY,
+    DEFAULT_TENANT,
+    AdmissionError,
+    ShedLoad,
+    WeightedFairQueue,
+    validate_priority,
+    validate_tenant,
+)
 from distributed_optimization_tpu.serving.cache import (
     ExecutableCache,
+    process_cache_enabled,
     process_executable_cache,
 )
 from distributed_optimization_tpu.serving.coalescer import (
@@ -78,7 +88,20 @@ class QueueFullError(ServingError):
     """Backpressure, not a bad request: the bounded queue is full and the
     submission should be RETRIED after in-flight work drains. The daemon
     maps it to 429 so clients can tell it apart from a permanently
-    invalid config."""
+    invalid config. Shed-load rejections (per-tenant or global caps,
+    ISSUE-15) carry the admission controller's reason and tenant."""
+
+    def __init__(self, detail, *, reason="global_cap", tenant=DEFAULT_TENANT):
+        super().__init__(detail)
+        self.reason = reason
+        self.tenant = tenant
+
+
+class DrainingError(ServingError):
+    """The service is draining toward shutdown: in-flight work finishes,
+    NEW submissions are refused. The daemon maps it to 503 — retryable by
+    the client contract, because a drain usually precedes a restart that
+    will accept the retry."""
 
 
 @dataclasses.dataclass
@@ -108,6 +131,17 @@ class ServingOptions:
     health block. Observation only — the serving plane never halts a
     paying request (``halt_on='never'``); it costs one Python callback
     per heartbeat.
+
+    Admission/fairness (ISSUE-15): ``max_pending_per_tenant`` caps one
+    tenant's queued depth (None = only the global bound), and
+    ``tenant_weights`` biases the weighted-fair scheduler (unlisted
+    tenants weigh 1.0). ``cut_budget`` bounds how many requests one
+    scheduler cut dequeues (None = everything pending — the PR-7
+    behavior); a bounded cut is what keeps a backlogged tenant from
+    monopolizing execution order between cuts. ``workers`` > 0 runs
+    cohorts on that many spawned worker processes (``serving/
+    workers.py``) instead of the scheduler thread — the persistent store
+    (``DOPT_EXEC_STORE``) is their shared warm tier.
     """
 
     window_s: float = 0.05
@@ -120,6 +154,10 @@ class ServingOptions:
     # dominated by its compile anyway).
     progress_every: int = 5
     monitors: bool = True
+    max_pending_per_tenant: Optional[int] = None
+    tenant_weights: Optional[dict] = None
+    cut_budget: Optional[int] = None
+    workers: int = 0
 
     def __post_init__(self) -> None:
         if self.progress_every < 1:
@@ -140,6 +178,14 @@ class ServingOptions:
             raise ValueError(
                 f"max_done must be >= 1, got {self.max_done}"
             )
+        if self.cut_budget is not None and self.cut_budget < 1:
+            raise ValueError(
+                f"cut_budget must be >= 1, got {self.cut_budget}"
+            )
+        if self.workers < 0:
+            raise ValueError(
+                f"workers must be >= 0, got {self.workers}"
+            )
 
 
 @dataclasses.dataclass
@@ -149,6 +195,13 @@ class Request:
     id: str
     config: ExperimentConfig
     submitted_at: float
+    # Admission facts (ISSUE-15): which tenant submitted it and at what
+    # priority class — what the weighted-fair scheduler ordered on.
+    tenant: str = DEFAULT_TENANT
+    priority: str = DEFAULT_PRIORITY
+    # Which worker process executed it (multi-worker plane); None when
+    # the scheduler thread ran it in-process.
+    worker: Optional[int] = None
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False
     )
@@ -179,6 +232,8 @@ class Request:
             "id": self.id,
             "status": self.status,
             "config_hash": self.config.structural_hash(),
+            "tenant": self.tenant,
+            "priority": self.priority,
         }
         if self.error is not None:
             out["error"] = self.error
@@ -205,6 +260,9 @@ class Request:
             "sequential_reason": self.sequential_reason,
             "queue_wait_s": self.queue_wait_s,
             "run_wall_s": self.run_wall_s,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "worker": self.worker,
         }
 
 
@@ -264,7 +322,27 @@ class SimulationService:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._pending: list[Request] = []
+        # The admission-controlled queue (ISSUE-15): per-(tenant,
+        # priority) sub-queues under a deficit-round-robin scheduler.
+        # Pushes and cuts happen under the SERVICE lock (the WFQ's own
+        # lock is a leaf) so the QUEUED-before-RUNNING lifecycle ordering
+        # survives: a cut can never interleave between a push and its
+        # QUEUED publish.
+        self._queue = WeightedFairQueue(
+            max_pending=self.options.max_pending,
+            max_pending_per_tenant=self.options.max_pending_per_tenant,
+            tenant_weights=self.options.tenant_weights,
+        )
+        # Requests cut from the queue but not yet finished — what a
+        # graceful drain waits out alongside the queue itself.
+        self._inflight = 0
+        self._draining = False
+        # Multi-worker plane (options.workers > 0): created on demand so
+        # a plain in-process service never spawns anything.
+        self._pool = None
+        self._executor = None
+        self._gauge_lock = threading.Lock()
+        self._gauge_tenants: set[str] = set()
         self._requests: dict[str, Request] = {}
         # Finished-request ids in completion order — the bounded history
         # (ServingOptions.max_done) a long-lived daemon rotates through.
@@ -310,45 +388,101 @@ class SimulationService:
             "Requests pending in the serving queue",
             self.queue_depth,
         )
+        # Admission metrics (ISSUE-15 satellite): the shed counter is a
+        # labeled family so dashboards split rejections by cause and
+        # actor; registered here so a cold daemon renders it as a valid
+        # zero series before any shed happens.
+        self._m_shed = reg.counter(
+            "dopt_serving_shed_total",
+            "Submissions refused by admission control, by reason "
+            "(tenant_cap/global_cap) and tenant",
+        )
+        self._m_tenant_depth = reg.gauge(
+            "dopt_serving_tenant_queue_depth",
+            "Requests pending in the serving queue, per tenant",
+        )
 
     # ---------------------------------------------------------- submission
-    def submit(self, config) -> str:
+    def submit(self, config, *, tenant=None, priority=None) -> str:
         """Validate and enqueue one request; returns its id.
 
-        Raises ``ServingError`` for malformed/invalid configs and when the
-        queue is full — rejected requests never enter the queue.
+        Raises ``ServingError`` for malformed/invalid configs (including
+        malformed tenant/priority fields), ``QueueFullError`` when
+        admission sheds the request (per-tenant or global cap), and
+        ``DrainingError`` while a graceful drain is in progress —
+        rejected requests never enter the queue.
         """
         cfg = parse_config(config)
         if cfg.replicas > 1:
             raise ServingError(REPLICAS_UNSUPPORTED_REASON)
+        try:
+            tenant = validate_tenant(tenant)
+            priority = validate_priority(priority)
+        except AdmissionError as e:
+            # Re-raise as the structured 400 the daemon already maps —
+            # a malformed tenant field is a bad request, not a 500.
+            raise ServingError(str(e)) from e
+        shed: Optional[ShedLoad] = None
         with self._lock:
-            if len(self._pending) >= self.options.max_pending:
-                raise QueueFullError(
-                    f"queue full ({self.options.max_pending} pending); "
-                    "retry after in-flight work drains"
+            if self._draining:
+                raise DrainingError(
+                    "service is draining toward shutdown; new submissions "
+                    "are refused (retry against the restarted instance)"
                 )
-            self._counter += 1
             req = Request(
-                id=f"req-{self._counter:06d}",
+                id=f"req-{self._counter + 1:06d}",
                 config=cfg,
                 submitted_at=time.perf_counter(),
+                tenant=tenant,
+                priority=priority,
             )
-            # QUEUED must hit the stream BEFORE the request becomes
-            # visible to the scheduler (the append): published after the
-            # lock released, a scheduler thread already past its wait
-            # could pop the request and publish RUNNING first, handing
-            # subscribers an out-of-order lifecycle. The stream lock is a
-            # leaf (publish never calls back into the service), so
-            # publishing under the service lock cannot invert an order.
-            req.progress.publish(ProgressEvent(
-                kind="lifecycle", iteration=0,
-                n_iterations=cfg.n_iterations, wall_seconds=0.0,
-                status=QUEUED,
-            ))
-            self._pending.append(req)
-            self._requests[req.id] = req
+            try:
+                self._queue.push(req, tenant=tenant, priority=priority)
+            except ShedLoad as e:
+                shed = e
+            else:
+                self._counter += 1
+                # QUEUED must hit the stream BEFORE the request becomes
+                # visible to a scheduler cut: published after the lock
+                # released, a scheduler thread already past its wait
+                # could cut the request and publish RUNNING first,
+                # handing subscribers an out-of-order lifecycle. (The
+                # push above IS visibility, but cuts also take this
+                # lock, so no cut can interleave before the publish.)
+                # The stream lock is a leaf (publish never calls back
+                # into the service), so publishing under the service
+                # lock cannot invert an order.
+                req.progress.publish(ProgressEvent(
+                    kind="lifecycle", iteration=0,
+                    n_iterations=cfg.n_iterations, wall_seconds=0.0,
+                    status=QUEUED,
+                ))
+                self._requests[req.id] = req
+        if shed is not None:
+            # Registry counters outside the service lock (the gauge
+            # callbacks re-enter the service under the registry lock —
+            # the ABBA convention every instrumented path here follows).
+            self._m_shed.inc(reason=shed.reason, tenant=shed.tenant)
+            raise QueueFullError(
+                f"shed ({shed.reason}): {shed}; retry with backoff",
+                reason=shed.reason, tenant=shed.tenant,
+            ) from shed
+        self._publish_tenant_depths()
         self._wake.set()
         return req.id
+
+    def _publish_tenant_depths(self) -> None:
+        """Refresh the per-tenant depth gauge family from the queue's
+        current state; tenants that drained to zero keep an explicit 0
+        series (a vanished series reads as 'scrape lost it', a 0 reads
+        as 'empty'). Never called under the service lock."""
+        depths = self._queue.depths()
+        with self._gauge_lock:
+            for t in self._gauge_tenants - set(depths):
+                self._m_tenant_depth.set(0, tenant=t)
+            for t, d in depths.items():
+                self._m_tenant_depth.set(d, tenant=t)
+            self._gauge_tenants |= set(depths)
 
     # ------------------------------------------------------------- lookup
     def get(self, request_id: str) -> Request:
@@ -370,24 +504,67 @@ class SimulationService:
 
     # ---------------------------------------------------------- scheduling
     def queue_depth(self) -> int:
-        with self._lock:
-            return len(self._pending)
+        return len(self._queue)
 
     def process_once(self) -> int:
-        """Cut cohorts from everything currently pending and execute them;
+        """Cut a weighted-fair batch from the queue and execute it;
         returns the number of requests resolved. The scheduler loop calls
-        this after the wait window; tests call it directly for determinism.
+        this after the wait window; tests call it directly for
+        determinism. The cut takes everything pending unless
+        ``options.cut_budget`` bounds it (then a backlogged tenant's
+        excess stays queued for later rounds — the fairness lever).
+
+        With workers configured, the cut's plans run CONCURRENTLY across
+        the worker processes (one executor thread per in-flight plan);
+        in-process mode executes them serially on the calling thread,
+        exactly the PR-7 behavior.
         """
-        with self._lock:
-            batch, self._pending = self._pending, []
+        with self._lock:  # cut under the service lock — see submit()
+            batch = self._queue.cut(self.options.cut_budget)
+            self._inflight += len(batch)
         if not batch:
             return 0
+        self._publish_tenant_depths()
         plans = plan_cohorts(batch, self.options.max_cohort)
-        n = 0
-        for plan in plans:
+        executor = self._ensure_workers()
+        if executor is not None and len(plans) > 1:
+            futures = [
+                executor.submit(self._execute_tracked, p) for p in plans
+            ]
+            for f in futures:
+                f.result()
+        else:
+            for plan in plans:
+                self._execute_tracked(plan)
+        return len(batch)
+
+    def _execute_tracked(self, plan) -> None:
+        try:
             self._execute(plan)
-            n += plan.size
-        return n
+        finally:
+            with self._lock:
+                self._inflight -= plan.size
+
+    def _ensure_workers(self):
+        """Spawn the worker pool + dispatch executor on first use (when
+        ``options.workers`` > 0); returns the executor or None."""
+        if self.options.workers <= 0:
+            return None
+        with self._lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                from distributed_optimization_tpu.serving.workers import (
+                    WorkerPool,
+                )
+
+                self._pool = WorkerPool(self.options.workers)
+                self._pool.start()
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self.options.workers,
+                    thread_name_prefix="serving-dispatch",
+                )
+            return self._executor
 
     def drain(self) -> int:
         """Process until the queue is empty (synchronous callers/tests)."""
@@ -395,6 +572,37 @@ class SimulationService:
         while self.queue_depth() > 0:
             total += self.process_once()
         return total
+
+    # ------------------------------------------------------ graceful drain
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def begin_drain(self) -> None:
+        """Refuse new submissions from now on; in-flight and queued work
+        keeps executing. ``/v1/shutdown?drain=1`` calls this, then
+        ``wait_drained`` — requests already accepted survive the drain
+        (tested with an in-flight cohort)."""
+        with self._lock:
+            self._draining = True
+        self._wake.set()
+
+    def wait_drained(self, timeout: float = 30.0) -> bool:
+        """Block until queued + in-flight work is fully finished or
+        ``timeout`` elapses; returns whether the service is empty. The
+        scheduler loop (or explicit ``process_once`` calls) must be
+        running for the queue to make progress."""
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            with self._lock:
+                empty = len(self._queue) == 0 and self._inflight == 0
+            if empty:
+                return True
+            self._wake.set()
+            time.sleep(0.02)
+        with self._lock:
+            return len(self._queue) == 0 and self._inflight == 0
 
     def dataset_for(self, cfg: ExperimentConfig):
         """Public dataset access sharing the service memo: the scenario
@@ -532,18 +740,41 @@ class SimulationService:
                 coalesced=plan.coalesced,
                 structural_hash=plan.base.structural_hash(),
             ):
-                ds, f_opt = self._dataset_for(plan.base)
-                results = execute_plan(
-                    plan, ds, f_opt,
-                    # Honor the kill switch: no cache means COLD compiles,
-                    # not a silently substituted private cache.
-                    executable_cache=(
-                        self.cache if self.cache is not None else False
-                    ),
-                    progress_factory=progress_factory,
-                    cohort_progress_cb=cohort_cb,
-                    progress_every=self.options.progress_every,
-                )
+                if self._pool is not None:
+                    # Multi-worker plane: ship the plan to a worker
+                    # process; its heartbeats route back into the same
+                    # per-request streams the in-process path feeds.
+                    deliverers = [
+                        progress_factory(r) for r in plan.requests
+                    ]
+
+                    def on_progress(idx, ev_dict):
+                        ev = ProgressEvent(**ev_dict)
+                        if idx is None:
+                            cohort_cb(ev)
+                        else:
+                            deliverers[idx](ev)
+
+                    results, worker_id = self._pool.run_plan(
+                        plan, on_progress,
+                        progress_every=self.options.progress_every,
+                    )
+                    for req in plan.requests:
+                        req.worker = worker_id
+                else:
+                    ds, f_opt = self._dataset_for(plan.base)
+                    results = execute_plan(
+                        plan, ds, f_opt,
+                        # Honor the kill switch: no cache means COLD
+                        # compiles, not a silently substituted private
+                        # cache.
+                        executable_cache=(
+                            self.cache if self.cache is not None else False
+                        ),
+                        progress_factory=progress_factory,
+                        cohort_progress_cb=cohort_cb,
+                        progress_every=self.options.progress_every,
+                    )
                 wall = time.perf_counter() - t_start
                 compile_s = min(
                     results[0].history.compile_seconds, wall
@@ -583,7 +814,12 @@ class SimulationService:
         )
         jax_cached_path = (
             plan.base.backend == "jax" and plan.base.tp_degree == 1
-            and self.cache is not None
+            and (
+                # Worker mode: each worker runs its own process cache,
+                # governed by the same kill switch it inherited.
+                process_cache_enabled() if self._pool is not None
+                else self.cache is not None
+            )
         )
         for req, res in zip(plan.requests, results):
             req.result = res
@@ -689,15 +925,28 @@ class SimulationService:
                 self.process_once()
             except Exception:  # pragma: no cover - belt and braces
                 _log.exception("scheduler iteration failed; continuing")
+            # A bounded cut (options.cut_budget) can leave work queued
+            # with no further submit to wake us — re-arm so the backlog
+            # drains round by round instead of stalling until the next
+            # submission.
+            if self.queue_depth() > 0:
+                self._wake.set()
 
     def close(self) -> None:
-        """Stop the scheduler loop (pending work stays queued)."""
+        """Stop the scheduler loop (pending work stays queued) and tear
+        down the worker plane when one was spawned."""
         self._stop.set()
         self._wake.set()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=5.0)
             self._thread = None
+        executor, pool = self._executor, self._pool
+        self._executor = self._pool = None
+        if executor is not None:
+            executor.shutdown(wait=False)
+        if pool is not None:
+            pool.close()
 
     # ------------------------------------------------------------ telemetry
     def stats(self) -> dict:
@@ -725,7 +974,18 @@ class SimulationService:
             )
 
             cache_stats = {"disabled": True, **ExecutableCache.empty_stats()}
+        # Queue/pool stats outside the service lock (each has its own
+        # leaf lock) — and the admission block is ALWAYS present with
+        # every key, zeros cold, like the cache block.
+        admission = {
+            **self._queue.stats(),
+            "depths": self._queue.depths(),
+        }
+        pool = self._pool
+        workers_stats = pool.stats() if pool is not None else None
         with self._lock:
+            admission["inflight"] = self._inflight
+            draining = self._draining
             sizes = list(self.cohort_sizes)
             waits = list(self.queue_waits)
             recent = [
@@ -734,7 +994,10 @@ class SimulationService:
                 if rid in self._requests
             ]
             out = {
-                "queue_depth": len(self._pending),
+                "queue_depth": len(self._queue),
+                "draining": draining,
+                "admission": admission,
+                "workers": workers_stats,
                 "requests_total": self._counter,
                 "requests_done": self.n_done,
                 "requests_failed": self.n_failed,

@@ -1,0 +1,570 @@
+"""Sustained-load serving bench (the ISSUE-15 tentpole evidence).
+
+Drives scenario-engine-sampled mixed traffic — eta/seed sweeps, faulty
+and Byzantine structural classes — through the PRODUCTION serving
+topology (HTTP daemon + multi-worker execution plane + persistent
+executable store) and measures the four things the serving plane is for:
+
+1. **Sustained latency** (``latency``): open-loop paced submits at a
+   controlled rate; p50/p99 submit→result wall time, split by the
+   manifest's own ``cache_hit`` flag (warm serves are the SLO surface;
+   cold compiles of a not-yet-seen cohort shape are counted separately —
+   mixed traffic legitimately contains them).
+2. **Saturation throughput** (``saturation``): the full stream submitted
+   closed-loop as fast as the wire accepts, requests/sec over the burst
+   — gated against the PR-7 coalesced baseline
+   (docs/perf/serving.json: 7.99 req/s), which a mixed-class stream
+   through real worker processes must not regress.
+3. **Admission control** (``shed`` + ``fairness``): a noisy tenant
+   hammering a capped daemon gets machine-readable 429s (shed rate
+   recorded, accepted work still completes); an adversarial tenant with
+   a deep backlog cannot starve a victim tenant — the victim's p99
+   under attack stays within a bounded factor of its solo p99
+   (weighted-fair scheduling, ``cut_budget``).
+4. **Restart warmness** (``restart``): a fresh service over the SAME
+   store directory replays every structural-class representative with 0
+   compile seconds and bitwise-identical objectives — the executables
+   were serialized by the *worker processes*, so this is the
+   cross-process store contract, not a same-process cache hit. (The
+   full SIGKILL-subprocess variant is ``make serve-restart-smoke``.)
+
+Plus the PR-7 parity gate re-checked through the worker plane: served
+results (including the Byzantine and edge-dropping classes) match direct
+in-process ``jax_backend.run`` to ≤ 1e-12 in float64.
+
+Asserted floors (bench.py convention, BENCH_NO_RANGE_CHECK escape):
+warm p99 submit→result ≤ 10 s (generous: this is a shared CPU
+container; the committed value is the honest SLO surface and the
+perf-diff checker envelopes it), saturation ≥ 7.99 req/s, victim p99
+ratio ≤ 8×, restart replay 100% warm + bitwise, parity ≤ 1e-12.
+
+Writes ``docs/perf/serving_load.json`` (+ manifest sidecar).
+
+Usage: python examples/bench_serving_load.py [--out PATH]
+         [--requests 360] [--rate 4.0] [--workers 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np
+
+FLOOR_SATURATION_RPS = 7.99   # PR-7 coalesced baseline (serving.json)
+WARM_P99_CEILING_S = 10.0     # warm submit->result, shared CPU container
+FAIR_RATIO_CEILING = 8.0      # victim p99 under attack vs solo
+PARITY_TOL = 1e-12
+
+BASE = {
+    "n_workers": 8, "n_samples": 160, "n_features": 6,
+    "n_informative_features": 4, "problem_type": "quadratic",
+    "n_iterations": 40, "eval_every": 20, "local_batch_size": 8,
+    "dtype": "float64",
+}
+
+# Structural-class axis: the distinct compiled programs mixed traffic
+# cycles through. eta / seed / edge_drop_prob ride the SWEEPABLE axes
+# (same program, coalescable); the attack / straggler / algorithm /
+# topology entries are genuinely different programs.
+STRUCTURE = [
+    {}, {},
+    {"algorithm": "gradient_tracking"},
+    {"topology": "fully_connected"},
+    {"attack": "sign_flip", "n_byzantine": 1,
+     "aggregation": "trimmed_mean", "robust_b": 1,
+     "partition": "shuffled"},
+    {"straggler_prob": 0.15},
+    {"edge_drop_prob": 0.2},
+]
+
+
+def _spec():
+    from distributed_optimization_tpu.scenarios.spec import parse_spec
+
+    return parse_spec({
+        "name": "serving-load-traffic", "seed": 5, "mode": "sample",
+        "sample": 60, "base": dict(BASE),
+        "axes": {
+            "structure": STRUCTURE,
+            "eta": [{}, {"learning_rate_eta0": 0.08},
+                    {"learning_rate_eta0": 0.12}],
+            "seed": [{}, {"seed": 2}, {"seed": 3}],
+        },
+    })
+
+
+def _class_reps():
+    """One representative config per distinct structural class (the
+    parity + restart-replay set)."""
+    from distributed_optimization_tpu.config import ExperimentConfig
+
+    seen, reps = set(), []
+    for over in STRUCTURE:
+        key = tuple(sorted(over.items()))
+        if key in seen:
+            continue
+        seen.add(key)
+        reps.append(ExperimentConfig(**{**BASE, **over}))
+    return reps
+
+
+def _pct(xs, q):
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), q))
+
+
+def _submit_then_fetch(client, ex, cfg, *, tenant=None, priority=None,
+                       timeout=600.0):
+    """Submit now; fetch the result on the executor. Returns a future
+    resolving to (latency_s, manifest)."""
+    t0 = time.perf_counter()
+    code, sub = client.submit(
+        cfg.to_dict(), tenant=tenant, priority=priority,
+    )
+    assert code == 202, (code, sub)
+    rid = sub["id"]
+
+    def fetch():
+        code, m = client.result(rid, timeout=timeout)
+        assert code == 200, (code, m)
+        return time.perf_counter() - t0, m
+
+    return ex.submit(fetch)
+
+
+def _paced(client, ex, configs, rate_hz, **kw):
+    """Open-loop arrivals at ``rate_hz``; returns [(latency, manifest)]."""
+    futs = []
+    t_start = time.perf_counter()
+    for i, cfg in enumerate(configs):
+        target = t_start + i / rate_hz
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        futs.append(_submit_then_fetch(client, ex, cfg, **kw))
+    return [f.result() for f in futs]
+
+
+def _burst(client, ex, configs, **kw):
+    """Closed-loop burst; returns (wall_s, [(latency, manifest)])."""
+    t0 = time.perf_counter()
+    futs = [_submit_then_fetch(client, ex, cfg, **kw) for cfg in configs]
+    out = [f.result() for f in futs]
+    return time.perf_counter() - t0, out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/perf/serving_load.json")
+    ap.add_argument("--requests", type=int, default=360,
+                    help="sustained/saturation stream length (the "
+                         "sampled cells repeat cyclically)")
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="paced-phase arrival rate (requests/sec)")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="worker processes behind the main daemon")
+    args = ap.parse_args()
+
+    import jax
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.scenarios.engine import sample_traffic
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.client import RetryingClient
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+    from distributed_optimization_tpu.serving.store import (
+        PersistentExecutableStore,
+    )
+    from distributed_optimization_tpu.utils.profiling import PhaseTimer
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    print(f"[load] device={dev} platform={platform}", file=sys.stderr)
+    timer = PhaseTimer()
+    tmp = tempfile.mkdtemp(prefix="dopt-load-store-")
+    # The single wiring point: spawned workers inherit it, so every
+    # worker's process cache writes through to the one store.
+    os.environ["DOPT_EXEC_STORE"] = tmp
+
+    # ---- 0. traffic: scenario-engine-sampled mixed stream -------------
+    with timer.phase("traffic"):
+        cells = sample_traffic(_spec())
+        stream = [cells[i % len(cells)] for i in range(args.requests)]
+        reps = _class_reps()
+    traffic = {
+        "sampled_cells": len(cells),
+        "structural_classes": len(reps),
+        "requests": len(stream),
+        "composition": "scenario sample over structure x eta x seed "
+                       "(attack/straggler/edge-drop classes included), "
+                       "repeated cyclically",
+    }
+    print(
+        f"[load] traffic: {len(cells)} sampled cells -> "
+        f"{len(stream)} requests over {len(reps)} structural classes",
+        file=sys.stderr,
+    )
+
+    svc = SimulationService(
+        ServingOptions(window_s=0.05, max_cohort=8, workers=args.workers),
+        cache=ExecutableCache(store=PersistentExecutableStore(tmp)),
+    )
+    daemon = ServingDaemon("127.0.0.1", 0, service=svc)
+    daemon.start()
+    client = RetryingClient(daemon.url, max_retries=6, seed=0)
+    ex = ThreadPoolExecutor(max_workers=64)
+    rep_arrays = {}
+    try:
+        # ---- 1. warmup: class reps one-at-a-time, then one burst ------
+        with timer.phase("warmup"):
+            for i, cfg in enumerate(reps):
+                code, sub = client.submit(cfg.to_dict())
+                assert code == 202, (code, sub)
+                code, m = client.result(sub["id"], timeout=600.0)
+                assert code == 200, (code, m)
+                # The full arrays (the wire manifest only carries the
+                # final gap) — the daemon's service is in-process here.
+                req = svc.result(sub["id"], timeout=30)
+                rep_arrays[i] = req.result.history.objective.copy()
+            _burst(client, ex, stream)
+        st0 = svc.stats()
+        print(
+            f"[load] warmup: {st0['cache']['misses']} compiles, "
+            f"{st0['cache'].get('store', {})} store",
+            file=sys.stderr,
+        )
+
+        # ---- 2. sustained paced latency -------------------------------
+        with timer.phase("sustained"):
+            paced = _paced(client, ex, stream, args.rate)
+        warm = [lat for lat, m in paced
+                if m["health"]["serving"]["cache_hit"]]
+        cold = [lat for lat, m in paced
+                if not m["health"]["serving"]["cache_hit"]]
+        assert warm, "no warm serves in the sustained phase"
+        latency = {
+            "rate_hz": args.rate,
+            "requests": len(paced),
+            "warm_requests": len(warm),
+            "cold_requests": len(cold),
+            "warm_p50_s": round(_pct(warm, 50), 4),
+            "warm_p99_s": round(_pct(warm, 99), 4),
+            "all_p50_s": round(_pct([l for l, _ in paced], 50), 4),
+            "all_p99_s": round(_pct([l for l, _ in paced], 99), 4),
+            "cold_p99_s": round(_pct(cold, 99), 4) if cold else None,
+        }
+        print(
+            f"[load] sustained @ {args.rate}/s: warm p50 "
+            f"{latency['warm_p50_s']}s p99 {latency['warm_p99_s']}s "
+            f"({len(cold)} cold serves excluded from the SLO cell)",
+            file=sys.stderr,
+        )
+
+        # ---- 3. saturation: closed-loop burst -------------------------
+        misses_before = svc.stats()["cache"]["misses"]
+        with timer.phase("saturation"):
+            wall, done = _burst(client, ex, stream)
+        sat_rps = len(done) / wall
+        saturation = {
+            "requests": len(done),
+            "wall_s": round(wall, 2),
+            "requests_per_s": round(sat_rps, 2),
+            "cold_compiles_in_burst":
+                svc.stats()["cache"]["misses"] - misses_before,
+            "pr7_coalesced_baseline_rps": FLOOR_SATURATION_RPS,
+            "saturation_loses": sat_rps < FLOOR_SATURATION_RPS,
+        }
+        print(
+            f"[load] saturation: {len(done)} requests in {wall:.1f}s = "
+            f"{sat_rps:.2f} req/s "
+            f"({saturation['cold_compiles_in_burst']} cold compiles)",
+            file=sys.stderr,
+        )
+
+        # ---- 4. parity through the worker plane -----------------------
+        with timer.phase("parity"):
+            max_dev = 0.0
+            for i, cfg in enumerate(reps):
+                ds, f_opt = svc.dataset_for(cfg)
+                direct = jax_backend.run(
+                    cfg, ds, f_opt, executable_cache=False,
+                )
+                max_dev = max(max_dev, float(np.max(np.abs(
+                    rep_arrays[i] - direct.history.objective
+                ))))
+        assert max_dev <= PARITY_TOL, (
+            f"served-vs-direct deviation {max_dev} through the worker "
+            f"plane exceeds {PARITY_TOL}"
+        )
+        parity = {
+            "classes": len(reps),
+            "max_abs_deviation_f64": max_dev,
+            "tol": PARITY_TOL,
+            "includes": "byzantine (sign_flip/trimmed_mean) and "
+                        "edge-drop/straggler classes",
+        }
+        print(f"[load] parity: max dev {max_dev:.2e} (f64)", file=sys.stderr)
+    finally:
+        try:
+            client.shutdown()
+        except Exception:
+            pass
+        daemon.stop()
+        ex.shutdown(wait=False)
+
+    # ---- 5. shed: per-tenant caps under a hammering tenant ------------
+    with timer.phase("shed"):
+        shed_svc = SimulationService(
+            ServingOptions(window_s=0.0, max_cohort=1,
+                           max_pending_per_tenant=6),
+            cache=ExecutableCache(store=PersistentExecutableStore(tmp)),
+        )
+        shed_daemon = ServingDaemon("127.0.0.1", 0, service=shed_svc)
+        shed_daemon.start()
+        try:
+            raw = RetryingClient(shed_daemon.url, max_retries=0)
+            # A structural class nothing warmed: its compile is the
+            # plug that lets the noisy tenant's backlog build.
+            from distributed_optimization_tpu.config import ExperimentConfig
+
+            plug = ExperimentConfig(**{**BASE, "topology": "star"})
+            accepted, sheds = [], 0
+            for i in range(30):
+                code, body = raw._once(
+                    "POST", "/v1/submit",
+                    {"config": plug.replace(seed=10 + i).to_dict(),
+                     "tenant": "noisy"},
+                    30.0,
+                )
+                if code == 202:
+                    accepted.append(body["id"])
+                else:
+                    assert code == 429 and body["reason"] == "tenant_cap", (
+                        code, body,
+                    )
+                    sheds += 1
+            assert sheds > 0, "the tenant cap never shed"
+            # Accepted work still completes — shedding protects the
+            # queue, it does not poison it.
+            for rid in accepted:
+                code, m = raw.result(rid, timeout=600.0)
+                assert code == 200, (code, m)
+            scrape = raw.metrics_text()
+            assert "dopt_serving_shed_total" in scrape
+        finally:
+            shed_daemon.stop()
+    shed = {
+        "attempts": 30,
+        "accepted": len(accepted),
+        "tenant_cap_sheds": sheds,
+        "shed_rate": round(sheds / 30.0, 3),
+        "tenant_cap": 6,
+    }
+    print(
+        f"[load] shed: {sheds}/30 submits shed at cap 6, "
+        f"{len(accepted)} accepted all completed",
+        file=sys.stderr,
+    )
+
+    # ---- 6. fairness: adversarial tenant vs paced victim --------------
+    with timer.phase("fairness"):
+        fair_svc = SimulationService(
+            ServingOptions(window_s=0.0, max_cohort=1, cut_budget=2),
+            cache=ExecutableCache(store=PersistentExecutableStore(tmp)),
+        )
+        fair_daemon = ServingDaemon("127.0.0.1", 0, service=fair_svc)
+        fair_daemon.start()
+        fex = ThreadPoolExecutor(max_workers=96)
+        try:
+            fc = RetryingClient(fair_daemon.url, max_retries=6, seed=1)
+            victim_cfgs = [reps[0].replace(seed=40 + i) for i in range(8)]
+            adversary_cfgs = [reps[1].replace(seed=60 + i)
+                              for i in range(60)]
+            # Warm both classes' R=1 programs (store hits, no compile).
+            fc.run(victim_cfgs[0].to_dict(), timeout=600.0)
+            fc.run(adversary_cfgs[0].to_dict(), timeout=600.0)
+
+            solo = _paced(fc, fex, victim_cfgs, 0.8, tenant="victim")
+            solo_p99 = _pct([l for l, _ in solo], 99)
+
+            adv_futs = [
+                _submit_then_fetch(fc, fex, cfg, tenant="adversary")
+                for cfg in adversary_cfgs
+            ]
+            attacked = _paced(fc, fex, victim_cfgs, 0.8, tenant="victim")
+            attacked_p99 = _pct([l for l, _ in attacked], 99)
+            for f in adv_futs:  # adversary work still completes
+                f.result()
+        finally:
+            fair_daemon.stop()
+            fex.shutdown(wait=False)
+    ratio = attacked_p99 / solo_p99
+    fairness = {
+        "victim_requests": len(victim_cfgs),
+        "adversary_backlog": len(adversary_cfgs),
+        "victim_solo_p99_s": round(solo_p99, 4),
+        "victim_attacked_p99_s": round(attacked_p99, 4),
+        "victim_p99_ratio": round(ratio, 2),
+        "ratio_ceiling": FAIR_RATIO_CEILING,
+        "fairness_loses": ratio > FAIR_RATIO_CEILING,
+    }
+    print(
+        f"[load] fairness: victim p99 {solo_p99:.2f}s solo vs "
+        f"{attacked_p99:.2f}s under a {len(adversary_cfgs)}-deep "
+        f"adversary ({ratio:.1f}x)",
+        file=sys.stderr,
+    )
+
+    # ---- 7. restart: fresh process-state over the same store ----------
+    with timer.phase("restart"):
+        cache_r = ExecutableCache(store=PersistentExecutableStore(tmp))
+        restart_svc = SimulationService(
+            ServingOptions(window_s=0.0), cache=cache_r,
+        )
+        warm_replays, bitwise = 0, True
+        for i, cfg in enumerate(reps):
+            rid = restart_svc.submit(cfg)
+            restart_svc.drain()
+            req = restart_svc.result(rid, timeout=600)
+            if (req.cache_hit
+                    and req.result.history.compile_seconds == 0.0):
+                warm_replays += 1
+            if not np.array_equal(
+                req.result.history.objective, rep_arrays[i]
+            ):
+                bitwise = False
+        store_stats = cache_r.stats().get("store", {})
+    shutil.rmtree(tmp, ignore_errors=True)
+    warm_ratio = warm_replays / len(reps)
+    assert warm_ratio == 1.0, (
+        f"only {warm_replays}/{len(reps)} classes replayed warm from "
+        "the store after a restart"
+    )
+    assert bitwise, "restart replay is not bitwise vs the served run"
+    restart = {
+        "classes": len(reps),
+        "warm_replays": warm_replays,
+        "warm_ratio": warm_ratio,
+        "bitwise": bitwise,
+        "store": {k: (round(v, 3) if isinstance(v, float) else v)
+                  for k, v in store_stats.items()},
+        "subprocess_variant": "make serve-restart-smoke "
+                              "(SIGKILL + new process, same gate)",
+    }
+    print(
+        f"[load] restart: {warm_replays}/{len(reps)} classes warm from "
+        f"the store, bitwise={bitwise}",
+        file=sys.stderr,
+    )
+
+    # ---- asserted floors (BENCH_NO_RANGE_CHECK escape hatch) ----------
+    skip = os.environ.get("BENCH_NO_RANGE_CHECK", "").lower() not in (
+        "", "0", "false"
+    )
+    if skip:
+        print(
+            "[load] BENCH_NO_RANGE_CHECK set: skipping the floor gates "
+            "(non-canonical hardware mode)",
+            file=sys.stderr,
+        )
+    else:
+        assert latency["warm_p99_s"] <= WARM_P99_CEILING_S, (
+            f"warm p99 {latency['warm_p99_s']}s exceeds the "
+            f"{WARM_P99_CEILING_S}s ceiling"
+        )
+        assert sat_rps >= FLOOR_SATURATION_RPS, (
+            f"saturation {sat_rps:.2f} req/s is below the PR-7 "
+            f"coalesced baseline {FLOOR_SATURATION_RPS} req/s"
+        )
+        assert ratio <= FAIR_RATIO_CEILING, (
+            f"victim p99 degrades {ratio:.1f}x under the adversary "
+            f"(ceiling {FAIR_RATIO_CEILING}x) — fairness regressed"
+        )
+    gates = {
+        "applied": not skip,
+        "warm_p99_ceiling_s": WARM_P99_CEILING_S,
+        "measured_warm_p99_s": latency["warm_p99_s"],
+        "saturation_floor_rps": FLOOR_SATURATION_RPS,
+        "measured_saturation_rps": saturation["requests_per_s"],
+        "fairness_ratio_ceiling": FAIR_RATIO_CEILING,
+        "measured_fairness_ratio": fairness["victim_p99_ratio"],
+        "restart_all_warm": warm_ratio == 1.0,
+        "restart_bitwise": bitwise,
+        "shed_observed": sheds > 0,
+        "parity_max_abs_deviation_f64": max_dev,
+    }
+
+    payload = {
+        "device": str(dev),
+        "platform": platform,
+        "protocol": (
+            "Mixed traffic sampled from a scenario spec (structure x eta "
+            "x seed; Byzantine, straggler and edge-drop classes "
+            f"included) through ServingDaemon with {args.workers} worker "
+            "processes and a persistent executable store. latency: "
+            f"open-loop paced submits at {args.rate}/s, p50/p99 "
+            "submit->result split by the manifest's cache_hit flag. "
+            "saturation: the same stream closed-loop, req/s gated "
+            "against docs/perf/serving.json's coalesced baseline. shed: "
+            "a noisy tenant at a 6-deep per-tenant cap, 429 reason "
+            "asserted, accepted work completing. fairness: a 60-deep "
+            "adversarial backlog vs an 8-request paced victim on a "
+            "cut_budget=2 weighted-fair scheduler, victim p99 ratio "
+            "bounded. restart: a fresh service over the same store "
+            "replays every structural class with 0 compile seconds, "
+            "bitwise. parity: served (worker-plane) vs direct run, f64."
+        ),
+        "note": (
+            "CPU-container numbers: wall-clock cells (latencies, req/s) "
+            "are envelope-checked, not pinned — the load-bearing "
+            "evidence is the boolean gates (restart warm+bitwise, shed "
+            "observed, fairness bounded, parity) plus the committed "
+            "floor constants. The warm-p99 SLO cell excludes cold "
+            "serves honestly surfaced by mixed traffic (counted in "
+            "cold_requests); saturation_loses / fairness_loses flag "
+            "any measured inversion per repo convention."
+        ),
+        "traffic": traffic,
+        "latency": latency,
+        "saturation": saturation,
+        "shed": shed,
+        "fairness": fairness,
+        "restart": restart,
+        "parity": parity,
+        "gates": gates,
+    }
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+
+    write_bench_manifest(
+        path, config=ExperimentConfig(**BASE), phases=timer,
+    )
+
+    print(json.dumps({
+        "metric": "serving_load_warm_p99_and_saturation",
+        "warm_p99_s": latency["warm_p99_s"],
+        "saturation_rps": saturation["requests_per_s"],
+        "fairness_ratio": fairness["victim_p99_ratio"],
+        "restart_warm_ratio": warm_ratio,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""``make serve-restart-smoke``: a FULL process restart over the
+persistent executable store (the ISSUE-15 restart-warm gate).
+
+The in-process variants of this gate live in tier-1
+(tests/test_exec_store.py) and the chaos suite (``store_restart`` mode);
+this smoke is the operational proof with nothing shared but the disk:
+
+1. spawns daemon A as a real subprocess (``python -m
+   distributed_optimization_tpu.serve --store DIR --port 0
+   --port-file F``), waits for the port file;
+2. serves one config cold over the wire — a compile happens, and the
+   executable is written through to the store;
+3. SIGKILLs daemon A (no drain, no atexit — the crash case);
+4. spawns daemon B over the SAME store directory, replays the SAME
+   config, and asserts the restart-warm contract: ``cache_hit`` true,
+   ``compile_seconds == 0.0``, and a bitwise-identical final gap;
+5. shuts daemon B down cleanly.
+
+Exit code 0 = all assertions passed.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+BOOT_DEADLINE_S = 180.0  # subprocess jax import + daemon bind
+
+
+def _spawn_daemon(store: str, port_file: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_optimization_tpu.serve",
+            "--port", "0", "--port-file", port_file,
+            "--store", store, "--window-ms", "0", "--quiet",
+        ],
+        env=env, cwd=str(REPO),
+    )
+
+
+def _wait_port(port_file: str, proc: subprocess.Popen) -> str:
+    deadline = time.perf_counter() + BOOT_DEADLINE_S
+    while time.perf_counter() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(
+                f"daemon died during boot (exit {proc.returncode})"
+            )
+        try:
+            text = Path(port_file).read_text().strip()
+        except OSError:
+            text = ""
+        if text:
+            return f"http://{text}"
+        time.sleep(0.1)
+    raise SystemExit("daemon did not write its port file in time")
+
+
+def main() -> int:
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.serving.client import RetryingClient
+
+    cfg = ExperimentConfig(
+        n_workers=8, n_samples=160, n_features=6,
+        n_informative_features=4, problem_type="quadratic",
+        n_iterations=60, eval_every=20, local_batch_size=8,
+        dtype="float64",
+    )
+    with tempfile.TemporaryDirectory(prefix="dopt-restart-smoke-") as tmp:
+        store = os.path.join(tmp, "store")
+
+        # --- daemon A: cold serve, write-through to the store ----------
+        pf_a = os.path.join(tmp, "port_a")
+        proc_a = _spawn_daemon(store, pf_a)
+        try:
+            url_a = _wait_port(pf_a, proc_a)
+            print(f"[restart-smoke] daemon A at {url_a}", file=sys.stderr)
+            client_a = RetryingClient(url_a, max_retries=8, seed=0)
+            code, m1 = client_a.run(cfg.to_dict(), timeout=300.0)
+            assert code == 200, (code, m1)
+            serving1 = m1["health"]["serving"]
+            assert serving1["cache_hit"] is False, serving1
+            assert m1["compile_seconds"] > 0.0, m1["compile_seconds"]
+            gap1 = m1["health"]["final_gap"]
+            artifacts = list(Path(store).glob("*.dopt-exec"))
+            assert artifacts, "no executable persisted to the store"
+            print(
+                f"[restart-smoke] cold serve: compile "
+                f"{m1['compile_seconds']:.2f}s, {len(artifacts)} "
+                f"artifact(s) on disk",
+                file=sys.stderr,
+            )
+        finally:
+            # --- the crash: SIGKILL, nothing flushed -------------------
+            if proc_a.poll() is None:
+                proc_a.send_signal(signal.SIGKILL)
+            proc_a.wait(timeout=30.0)
+        print("[restart-smoke] daemon A SIGKILLed", file=sys.stderr)
+
+        # --- daemon B: same store, must start warm ---------------------
+        pf_b = os.path.join(tmp, "port_b")
+        proc_b = _spawn_daemon(store, pf_b)
+        try:
+            url_b = _wait_port(pf_b, proc_b)
+            print(f"[restart-smoke] daemon B at {url_b}", file=sys.stderr)
+            client_b = RetryingClient(url_b, max_retries=8, seed=0)
+            code, m2 = client_b.run(cfg.to_dict(), timeout=300.0)
+            assert code == 200, (code, m2)
+            serving2 = m2["health"]["serving"]
+            assert serving2["cache_hit"] is True, serving2
+            assert m2["compile_seconds"] == 0.0, (
+                f"restart replay recompiled "
+                f"({m2['compile_seconds']}s) — the store did not warm "
+                "the new process"
+            )
+            gap2 = m2["health"]["final_gap"]
+            assert gap1 is not None and gap1 == gap2, (
+                f"restart replay is not bitwise: {gap1!r} vs {gap2!r}"
+            )
+            print(
+                "[restart-smoke] restart replay: 0 compile seconds, "
+                f"bitwise final gap {gap2:.6e}",
+                file=sys.stderr,
+            )
+            code, body = client_b.shutdown()
+            assert code == 200 and body["status"] == "shutting_down"
+            proc_b.wait(timeout=60.0)
+        finally:
+            if proc_b.poll() is None:
+                proc_b.send_signal(signal.SIGKILL)
+                proc_b.wait(timeout=30.0)
+    print("[restart-smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,425 @@
+"""Admission control + per-tenant weighted-fair scheduling (ISSUE-15):
+DRR unit behavior, shed-load reasons, starvation-freedom under an
+adversarial tenant, and the concurrent mixed-tenant serving path with
+mid-run metrics scrapes (``serving/admission.py``)."""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import pytest
+
+from distributed_optimization_tpu.config import ExperimentConfig
+from distributed_optimization_tpu.serving.admission import (
+    DEFAULT_PRIORITY,
+    DEFAULT_TENANT,
+    PRIORITY_MULTIPLIERS,
+    AdmissionError,
+    ShedLoad,
+    WeightedFairQueue,
+    validate_priority,
+    validate_tenant,
+)
+
+
+def _push_n(q, tenant, n, priority="normal", tag=None):
+    for i in range(n):
+        q.push(f"{tag or tenant}-{i}", tenant=tenant, priority=priority)
+
+
+# ------------------------------------------------------------- DRR unit
+
+
+def test_round_robin_interleaves_equal_tenants():
+    q = WeightedFairQueue(max_pending=100)
+    _push_n(q, "a", 3)
+    _push_n(q, "b", 3)
+    # Equal weights, equal priority: one request per tenant per round,
+    # FIFO within each tenant.
+    assert q.cut(4) == ["a-0", "b-0", "a-1", "b-1"]
+    assert q.cut() == ["a-2", "b-2"]
+    assert len(q) == 0
+    assert q.stats()["dispatched"] == 6
+
+
+def test_adversarial_backlog_cannot_starve_victim():
+    """The fairness property the module exists for: a tenant with a
+    1000-deep backlog still yields one slot per round, so a victim's
+    single request is dispatched in the FIRST cut."""
+    q = WeightedFairQueue(max_pending=2000)
+    _push_n(q, "adversary", 1000)
+    q.push("victim-0", tenant="victim", priority="normal")
+    first_cut = q.cut(2)
+    assert "victim-0" in first_cut
+    # And the adversary still gets its fair share, not zero.
+    assert any(r.startswith("adversary") for r in first_cut)
+
+
+def test_priority_multipliers_shape_bandwidth():
+    """"high" drains 4 requests per round for every 1 of "normal"."""
+    q = WeightedFairQueue(max_pending=100)
+    _push_n(q, "a", 8, priority="high")
+    _push_n(q, "b", 8, priority="normal")
+    out = q.cut(10)
+    assert sum(1 for r in out if r.startswith("a")) == 8
+    assert sum(1 for r in out if r.startswith("b")) == 2
+
+
+def test_low_priority_progresses_every_round():
+    """"low" (0.25) accumulates deficit across rounds — background
+    traffic is slowed, never starved."""
+    q = WeightedFairQueue(max_pending=100)
+    _push_n(q, "a", 12, priority="normal")
+    _push_n(q, "a", 3, priority="low", tag="bg")
+    out = q.cut()
+    # 0.25/round: the first background request needs 4 rounds, and all
+    # three drain before the queue empties.
+    assert sum(1 for r in out if r.startswith("bg")) == 3
+    assert out.index("bg-0") > out.index("a-3")
+
+
+def test_tenant_weights_scale_share():
+    q = WeightedFairQueue(max_pending=100, tenant_weights={"big": 3.0})
+    _push_n(q, "big", 9)
+    _push_n(q, "small", 9)
+    out = q.cut(8)
+    assert sum(1 for r in out if r.startswith("big")) == 6
+    assert sum(1 for r in out if r.startswith("small")) == 2
+
+
+def test_deficit_resets_when_entity_drains():
+    """An idle tenant must not bank credit for a later burst: emptied
+    entities leave the ring with their deficit discarded."""
+    q = WeightedFairQueue(max_pending=100)
+    _push_n(q, "a", 2)
+    q.cut()
+    assert q._deficits == {} and q._queues == OrderedDict()
+    # Refill: behaves exactly like a fresh queue (no banked deficit).
+    _push_n(q, "a", 3)
+    _push_n(q, "b", 3)
+    assert q.cut(2) == ["a-0", "b-0"]
+
+
+# ----------------------------------------------------------- caps + sheds
+
+
+def test_per_tenant_cap_sheds_with_blame():
+    q = WeightedFairQueue(max_pending=100, max_pending_per_tenant=2)
+    _push_n(q, "noisy", 2)
+    with pytest.raises(ShedLoad) as ei:
+        q.push("noisy-2", tenant="noisy", priority="normal")
+    assert ei.value.reason == "tenant_cap"
+    assert ei.value.tenant == "noisy"
+    # Another tenant is unaffected by the noisy one's cap.
+    q.push("quiet-0", tenant="quiet", priority="normal")
+    assert q.stats()["shed"] == 1
+
+
+def test_per_tenant_cap_spans_priorities():
+    q = WeightedFairQueue(max_pending=100, max_pending_per_tenant=2)
+    q.push("r0", tenant="t", priority="high")
+    q.push("r1", tenant="t", priority="low")
+    with pytest.raises(ShedLoad, match="cap 2"):
+        q.push("r2", tenant="t", priority="normal")
+
+
+def test_global_cap_sheds_and_tenant_cap_wins_blame():
+    q = WeightedFairQueue(max_pending=2, max_pending_per_tenant=2)
+    _push_n(q, "a", 2)
+    with pytest.raises(ShedLoad) as ei:
+        q.push("b-0", tenant="b", priority="normal")
+    assert ei.value.reason == "global_cap"
+    # A tenant at its OWN cap is blamed as tenant_cap even when the
+    # queue is also globally full — the client-visible reason names the
+    # actor that can fix it.
+    with pytest.raises(ShedLoad) as ei:
+        q.push("a-2", tenant="a", priority="normal")
+    assert ei.value.reason == "tenant_cap"
+
+
+def test_validation():
+    assert validate_tenant(None) == DEFAULT_TENANT
+    assert validate_priority(None) == DEFAULT_PRIORITY
+    assert validate_tenant("team-a.prod_1") == "team-a.prod_1"
+    for bad in ("", "-leading", "has space", "a" * 65, 7, 'evil"}'):
+        with pytest.raises(AdmissionError):
+            validate_tenant(bad)
+    with pytest.raises(AdmissionError):
+        validate_priority("urgent")
+    assert set(PRIORITY_MULTIPLIERS) == {"high", "normal", "low"}
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        WeightedFairQueue(max_pending=0)
+    with pytest.raises(ValueError):
+        WeightedFairQueue(max_pending=1, max_pending_per_tenant=0)
+    with pytest.raises(ValueError):
+        WeightedFairQueue(max_pending=1, tenant_weights={"t": 0.0})
+
+
+def test_depths_and_stats():
+    q = WeightedFairQueue(max_pending=10, max_pending_per_tenant=5)
+    _push_n(q, "a", 2)
+    _push_n(q, "a", 1, priority="high", tag="ah")
+    _push_n(q, "b", 1)
+    assert q.depths() == {"a": 3, "b": 1}
+    st = q.stats()
+    assert st["pending"] == 4 and st["tenants"] == 2
+    assert st["admitted"] == 4 and st["shed"] == 0
+    assert st["max_pending_per_tenant"] == 5
+
+
+# --------------------------------------------------- through the service
+
+
+def _small(**over):
+    fields = dict(
+        n_workers=4, n_samples=120, n_features=6, n_informative_features=4,
+        problem_type="quadratic", n_iterations=30, eval_every=10,
+        local_batch_size=8,
+    )
+    fields.update(over)
+    return ExperimentConfig(**fields)
+
+
+def _service(**opts):
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    return SimulationService(
+        ServingOptions(window_s=0.0, **opts), cache=ExecutableCache(),
+    )
+
+
+def test_service_sheds_with_reason_and_metric():
+    from distributed_optimization_tpu.observability.metrics_registry import (
+        metrics_registry,
+    )
+    from distributed_optimization_tpu.serving.service import QueueFullError
+
+    shed_before = metrics_registry().counter(
+        "dopt_serving_shed_total"
+    ).value(reason="tenant_cap", tenant="noisy")
+    service = _service(max_pending=10, max_pending_per_tenant=1)
+    try:
+        base = _small()
+        service.submit(base.to_dict(), tenant="noisy")
+        with pytest.raises(QueueFullError) as ei:
+            service.submit(
+                base.replace(seed=7).to_dict(), tenant="noisy",
+            )
+        assert ei.value.reason == "tenant_cap"
+        assert ei.value.tenant == "noisy"
+        assert metrics_registry().counter("dopt_serving_shed_total").value(
+            reason="tenant_cap", tenant="noisy"
+        ) == shed_before + 1
+        # The admission block is part of the service status.
+        adm = service.stats()["admission"]
+        assert adm["shed"] == 1 and adm["depths"] == {"noisy": 1}
+    finally:
+        service.close()
+
+
+def test_service_rejects_malformed_tenant_as_serving_error():
+    from distributed_optimization_tpu.serving.service import ServingError
+
+    service = _service(max_pending=10)
+    try:
+        with pytest.raises(ServingError, match="tenant"):
+            service.submit(_small().to_dict(), tenant="not ok")
+        with pytest.raises(ServingError, match="priority"):
+            service.submit(_small().to_dict(), priority="urgent")
+        assert service.queue_depth() == 0  # rejected before queueing
+    finally:
+        service.close()
+
+
+def test_adversarial_tenant_fairness_through_service():
+    """End-to-end starvation-freedom: with a bounded cut budget, a
+    victim's single request completes in the FIRST scheduler round
+    despite an adversary's deep backlog."""
+    service = _service(max_pending=64, cut_budget=2)
+    try:
+        base = _small()
+        for i in range(6):
+            service.submit(
+                base.replace(seed=100 + i).to_dict(), tenant="adversary",
+            )
+        victim = service.submit(base.replace(seed=7).to_dict(),
+                                tenant="victim")
+        n = service.process_once()
+        assert n == 2  # the budgeted cut: one adversary + the victim
+        req = service.get(victim)
+        assert req.status == "done"
+        assert req.tenant == "victim"
+        adm = service.stats()["admission"]
+        assert adm["depths"] == {"adversary": 5}
+        service.drain()
+    finally:
+        service.close()
+
+
+def test_scheduler_loop_drains_backlog_beyond_cut_budget():
+    """Regression (ISSUE-15 load bench): the scheduler loop must keep
+    cutting a backlog that exceeds ``cut_budget`` even when no further
+    submission arrives to wake it — a bounded cut re-arms its own wake
+    until the queue is empty."""
+    service = _service(max_pending=64, cut_budget=2)
+    try:
+        base = _small()
+        ids = [
+            service.submit(base.replace(seed=200 + i).to_dict(),
+                           tenant="bulk")
+            for i in range(7)
+        ]
+        service.start()  # loop only — no submits from here on
+        for rid in ids:
+            req = service.result(rid, timeout=120.0)
+            assert req.status == "done"
+        assert service.queue_depth() == 0
+    finally:
+        service.close()
+
+
+# ------------------------- concurrent mixed tenants + mid-run scrapes
+
+
+_PROM_LINE = re.compile(
+    r"^(#.*|[A-Za-z_:][A-Za-z0-9_:]*(\{[^}]*\})? -?[0-9.eE+\-]+(\.0)?|"
+    r"[A-Za-z_:][A-Za-z0-9_:]*(\{[^}]*\})? (NaN|[+-]Inf))$"
+)
+
+
+def _assert_valid_exposition(text: str) -> None:
+    for line in text.rstrip("\n").splitlines():
+        assert _PROM_LINE.match(line), f"invalid exposition line: {line!r}"
+
+
+def test_concurrent_mixed_tenants_with_midrun_scrapes():
+    """Threaded clients hammer submit/status/progress for three tenants
+    while a scraper reads /metrics mid-run: every request completes with
+    a full lifecycle, every scrape parses (no torn snapshots), and the
+    per-tenant facts survive into the manifests."""
+    from distributed_optimization_tpu.serving.client import RetryingClient
+    from distributed_optimization_tpu.serving.daemon import ServingDaemon
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    daemon = ServingDaemon(
+        "127.0.0.1", 0,
+        service=SimulationService(ServingOptions(window_s=0.02)),
+    )
+    daemon.start()
+    scrapes: list[str] = []
+    results: dict[str, dict] = {}
+    errors: list[BaseException] = []
+    stop_scraping = threading.Event()
+
+    def tenant_client(tenant: str, priority: str, seeds: list[int]):
+        try:
+            client = RetryingClient(daemon.url, max_retries=8,
+                                    backoff_s=0.05, seed=hash(tenant) % 97)
+            base = _small()
+            ids = []
+            for s in seeds:
+                code, sub = client.submit(
+                    base.replace(seed=s).to_dict(),
+                    tenant=tenant, priority=priority,
+                )
+                assert code == 202, sub
+                ids.append(sub["id"])
+            # Hammer /v1/status while waiting (the torn-snapshot bait).
+            code, st = client.status(timeout=30.0)
+            assert code == 200 and st["status"] == "serving"
+            for rid in ids:
+                code, manifest = client.result(rid, timeout=300.0)
+                assert code == 200, manifest
+                results[f"{tenant}:{rid}"] = manifest
+                events = list(client.progress_events(rid, timeout=30.0))
+                statuses = [
+                    e.get("status") for e in events
+                    if e.get("kind") == "lifecycle"
+                ]
+                # No lost lifecycle events: queued→running→done replay.
+                assert statuses[0] == "queued", statuses
+                assert statuses[-1] == "done", statuses
+                assert "running" in statuses
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    def scraper():
+        client = RetryingClient(daemon.url, max_retries=4,
+                                backoff_s=0.05, seed=3)
+        while not stop_scraping.is_set():
+            scrapes.append(client.metrics_text(timeout=10.0))
+            stop_scraping.wait(0.05)
+
+    scrape_thread = threading.Thread(target=scraper, daemon=True)
+    scrape_thread.start()
+    threads = [
+        threading.Thread(
+            target=tenant_client, args=(t, p, seeds), daemon=True,
+        )
+        for t, p, seeds in (
+            ("team-a", "high", [1, 2]),
+            ("team-b", "normal", [3, 4]),
+            ("team-c", "low", [5]),
+        )
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+            assert not t.is_alive(), "tenant client hung"
+    finally:
+        stop_scraping.set()
+        scrape_thread.join(timeout=10.0)
+        daemon.stop()
+    assert not errors, errors
+    assert len(results) == 5
+    # Per-tenant facts survive into the manifests' serving block.
+    for key, manifest in results.items():
+        tenant = key.split(":")[0]
+        serving = manifest["health"]["serving"]
+        assert serving["tenant"] == tenant
+    # Mid-run scrapes: present, and every one parses cleanly.
+    assert len(scrapes) >= 2
+    for text in scrapes:
+        _assert_valid_exposition(text)
+    final = scrapes[-1]
+    assert "dopt_serving_shed_total" in final
+    assert "dopt_serving_tenant_queue_depth" in final
+    # The three tenants' depth gauges all landed (drained to 0).
+    for tenant in ("team-a", "team-b", "team-c"):
+        assert re.search(
+            r'dopt_serving_tenant_queue_depth\{tenant="%s"\} 0' % tenant,
+            final,
+        ), f"missing zeroed depth gauge for {tenant}"
+
+
+def test_shed_and_depth_families_render_cold():
+    """Zero-state exposition (ISSUE-15 satellite): the shed counter and
+    tenant-depth gauge render as valid series before any traffic — a
+    fresh registry wired exactly like the service registers them."""
+    from distributed_optimization_tpu.observability.metrics_registry import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    reg.counter("dopt_serving_shed_total", "sheds by reason and tenant")
+    reg.gauge("dopt_serving_tenant_queue_depth", "per-tenant depth")
+    text = reg.render()
+    _assert_valid_exposition(text)
+    assert "dopt_serving_shed_total 0" in text
+    assert "dopt_serving_tenant_queue_depth 0" in text
+    assert "# TYPE dopt_serving_shed_total counter" in text
+    assert "# TYPE dopt_serving_tenant_queue_depth gauge" in text

@@ -64,6 +64,24 @@ def test_chaos_daemon_kill_restart():
     assert record.detail["killed_request_after_restart"]["status"] == 404
 
 
+def test_chaos_store_restart(tmp_path):
+    """ISSUE-15 restart-warm gate: a FULL process restart (fresh cache,
+    only the store directory survives) serves warm with 0 compile
+    seconds, the entry demonstrably loaded from disk."""
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_store_restart,
+    )
+
+    record = chaos_store_restart(store_root=str(tmp_path))
+    assert record.passed, record.detail
+    assert record.detail["restart_cache_hit"] is True
+    assert record.detail["restart_compile_seconds"] == 0.0
+    assert record.detail["store_load_hits"] >= 1
+    assert record.detail["final_gap_bitwise"]
+    # The store wrote real artifacts into the surviving directory.
+    assert any(p.suffix == ".dopt-exec" for p in tmp_path.iterdir())
+
+
 def test_chaos_suite_gates_and_metrics():
     """The suite's gate block is what the golden corpus commits; the
     injection gauge resets per run and carries one series per mode."""

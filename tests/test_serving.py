@@ -26,6 +26,8 @@ The contracts pinned here:
 """
 
 import json
+import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -593,3 +595,81 @@ def test_process_cache_env_kill_switch(monkeypatch):
     assert cache_mod.process_executable_cache() is None
     monkeypatch.delenv("DOPT_EXEC_CACHE")
     assert cache_mod.process_executable_cache() is not None
+
+
+# ------------------------------------------------------- graceful drain
+
+
+def test_service_drain_finishes_accepted_work():
+    """ISSUE-15 satellite: begin_drain refuses NEW submissions but every
+    request accepted before the drain — queued or in flight — completes
+    normally."""
+    from distributed_optimization_tpu.serving.service import DrainingError
+
+    service = _service()
+    try:
+        base = _cfg()
+        accepted = [
+            service.submit(base.replace(seed=s).to_dict()) for s in (1, 2)
+        ]
+        service.begin_drain()
+        assert service.draining
+        with pytest.raises(DrainingError):
+            service.submit(base.replace(seed=3).to_dict())
+        # The scheduler (here: explicit processing) still runs the
+        # accepted cohort to completion.
+        service.process_once()
+        assert service.wait_drained(timeout=30.0)
+        for rid in accepted:
+            assert service.result(rid, timeout=30.0).status == "done"
+    finally:
+        service.close()
+
+
+def test_daemon_drain_survives_inflight_cohort(daemon):
+    """``/v1/shutdown?drain=1``: an in-flight cohort survives the drain
+    (its results stay fetchable through the held-open shutdown), new
+    submissions answer 503, and the daemon then exits."""
+    base = _cfg().to_dict()
+    code, sub = _post(daemon.url + "/v1/submit", {"config": base})
+    assert code == 202
+    box = {}
+
+    def drain():
+        box["shutdown"] = _post(
+            daemon.url + "/v1/shutdown?drain=1&deadline=120", None,
+            timeout=150.0,
+        )
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    # New submissions are refused with the retryable 503 while draining.
+    deadline = time.time() + 30.0
+    refused = None
+    while time.time() < deadline:
+        code, err = _post(
+            daemon.url + "/v1/submit", {"config": base | {"seed": 9}}
+        )
+        if code == 503:
+            refused = err
+            break
+        assert code == 202, err  # drain not begun yet — request accepted
+        time.sleep(0.02)
+    assert refused is not None and refused["error"] == "draining"
+    # The in-flight request from before the drain still completes and
+    # its manifest is fetchable while the daemon holds the drain open.
+    code, res = _get(daemon.url + f"/v1/result/{sub['id']}?timeout=120")
+    assert code == 200 and res["kind"] == "run_trace"
+    t.join(timeout=150.0)
+    assert not t.is_alive()
+    code, body = box["shutdown"]
+    assert code == 200
+    assert body["status"] == "shutting_down" and body["drained"] is True
+
+
+def test_daemon_shutdown_default_unchanged(daemon):
+    """Without ?drain=1 the PR-7 contract is untouched: immediate stop,
+    no drained field."""
+    code, body = _post(daemon.url + "/v1/shutdown", None)
+    assert code == 200
+    assert body == {"status": "shutting_down"}

@@ -393,6 +393,9 @@ PERF_ARTIFACT_KEYS = {
     "serving.json": {
         "device", "platform", "protocol", "note", "workload", "latency",
         "throughput", "parity", "gates"},
+    "serving_load.json": {
+        "device", "platform", "protocol", "note", "traffic", "latency",
+        "saturation", "shed", "fairness", "restart", "parity", "gates"},
     "sparse_mixing.json": {
         "device", "end_to_end", "note", "op_level", "protocol"},
     "sweep.json": {
