@@ -4,9 +4,10 @@
 PY ?= python
 
 .PHONY: test smoke serve-smoke serve-restart-smoke observatory-smoke \
-	scenarios-smoke perf-diff bench-byzantine bench-churn \
+	scenarios-smoke fleet-smoke perf-diff bench-byzantine bench-churn \
 	bench-robust-scale bench-sweep bench-compute bench-telemetry \
-	bench-fused bench-serving bench-serving-load bench-federated \
+	bench-fused bench-serving bench-serving-load bench-fleet \
+	bench-federated \
 	bench-async bench-observatory bench-mesh bench-scenarios \
 	bench-monitors
 
@@ -30,10 +31,12 @@ smoke:
 		tests/test_federated.py tests/test_async.py \
 		tests/test_matrix_free_faults.py tests/test_observatory.py \
 		tests/test_monitors.py tests/test_worker_mesh.py \
-		tests/test_scenarios.py tests/test_scenario_chaos.py
+		tests/test_scenarios.py tests/test_scenario_chaos.py \
+		tests/test_fleet.py
 	$(MAKE) observatory-smoke
 	$(MAKE) scenarios-smoke
 	$(MAKE) serve-restart-smoke
+	$(MAKE) fleet-smoke
 
 # End-to-end scenario-engine smoke (docs/SCENARIOS.md): a seeded sample
 # over a mixed axis bank (validity agreement + per-cell invariants +
@@ -75,6 +78,14 @@ serve-smoke:
 # bitwise-identical final gap.
 serve-restart-smoke:
 	JAX_PLATFORMS=cpu $(PY) examples/serve_restart_smoke.py
+
+# Self-healing fleet chaos gate (docs/SCENARIOS.md, docs/SERVING.md
+# "Self-healing"): each remediation policy and the autoscaler proven
+# by its dedicated chaos mode — divergence halt + quarantine, store
+# corruption quarantine + cold recompile, SIGKILL storm, burst/idle
+# autoscale cycle — plus real worker-pool scale_up/scale_down.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest -q -x -m slow tests/test_fleet.py
 
 # Regenerate the Byzantine breakdown evidence (docs/perf/byzantine.json).
 bench-byzantine:
@@ -144,6 +155,13 @@ bench-serving:
 # restart-warm ratio, worker-plane f64 parity).
 bench-serving-load:
 	JAX_PLATFORMS=cpu $(PY) examples/bench_serving_load.py
+
+# Self-healing fleet soak (docs/SERVING.md "Self-healing"): mixed
+# traffic with chaos injections (planted divergence, worker SIGKILL,
+# store corruption, burst/idle autoscale cycle) through the fleet
+# reflex layer; every injection must come back remediated.
+bench-fleet:
+	JAX_PLATFORMS=cpu $(PY) examples/bench_fleet.py
 
 # Regenerate the live-observatory evidence (docs/perf/observatory.json:
 # heartbeat-on vs off steady-state overhead <= 3% ceiling + off/on

@@ -117,6 +117,28 @@ class _Family:
         with self._registry._lock:
             self._values.clear()
 
+    def replace(self, series) -> None:
+        """Atomically replace EVERY labeled series in this family (gauges
+        only) with ``series`` — an iterable of ``(labels_dict, value)``
+        pairs.
+
+        ``reset()`` + per-series ``set()`` is two-plus lock acquisitions:
+        a scrape landing between them observes a torn (empty or partial)
+        family. For run-scoped label universes that are republished
+        wholesale every poll — the fleet autoscaler's per-worker liveness
+        series is the motivating case — this swaps the whole set under
+        ONE lock acquisition, so a scale-down can never leave a stale
+        worker label exporting a topology that is no longer running, and
+        no scrape ever sees the family half-published.
+        """
+        if self.kind != "gauge":
+            raise TypeError(f"{self.name} is a {self.kind}, not a gauge")
+        new_values = {
+            _label_key(labels): float(value) for labels, value in series
+        }
+        with self._registry._lock:
+            self._values = new_values
+
     def observe(self, value: float, **labels) -> None:
         if self.kind != "histogram":
             raise TypeError(f"{self.name} is a {self.kind}, not a histogram")

@@ -786,20 +786,28 @@ def fault_context(config, onset: int, *, window: Optional[int] = None,
     return context
 
 
-def build_incident(config, anomaly: Anomaly, *, label: str = "") -> dict:
+def build_incident(config, anomaly: Anomaly, *, label: str = "",
+                   remediation: Optional[dict] = None) -> dict:
     """One schema-versioned forensic bundle for a fired anomaly (module
     docstring): the anomaly facts, the producing config (+ content and
     serving-cohort structural hashes), the evidence window, the
     fault/attack context around the onset, and the environment
     provenance. Serialized as JSONL next to RunTrace manifests via
-    ``write_incidents``."""
+    ``write_incidents``.
+
+    ``remediation``: optional structured block recording what the fleet's
+    policy engine (``serving/fleet.py``) DID about this incident —
+    ``{"policy", "outcome", "actions", ...}`` — so the forensic record
+    carries detection AND response in one bundle. Readers that predate
+    the fleet ignore the extra key (``read_incidents`` validates only
+    kind + schema_version)."""
     from distributed_optimization_tpu.telemetry import (
         config_hash,
         provenance,
     )
 
     cd = config.to_dict()
-    return {
+    out = {
         "schema_version": INCIDENT_SCHEMA_VERSION,
         "kind": "incident",
         "label": label,
@@ -814,6 +822,9 @@ def build_incident(config, anomaly: Anomaly, *, label: str = "") -> dict:
         "context": fault_context(config, anomaly.onset_iteration),
         "provenance": provenance(),
     }
+    if remediation is not None:
+        out["remediation"] = dict(remediation)
+    return out
 
 
 def incidents_path_for(manifest_path) -> Path:

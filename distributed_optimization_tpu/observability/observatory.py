@@ -203,6 +203,11 @@ class IncidentRecord:
     config_hash: Optional[str]
     structural_hash: Optional[str]
     algorithm: Optional[str]
+    # Fleet remediation attribution (ISSUE-16; ``serving/fleet.py``):
+    # what the policy engine DID about this incident. None when the
+    # bundle predates the fleet or nothing acted on it.
+    remediation_policy: Optional[str] = None
+    remediation_outcome: Optional[str] = None
 
     def row(self) -> str:
         onset = (
@@ -212,13 +217,14 @@ class IncidentRecord:
         return (
             f"{self.label[:28]:<30}{self.detector:<22}{self.severity:<8}"
             f"{onset:>8}  {(self.config_hash or '—')[:12]:<14}"
-            f"{(self.algorithm or '—'):<18}{self.message[:48]}"
+            f"{(self.algorithm or '—'):<18}"
+            f"{(self.remediation_outcome or '—'):<12}{self.message[:48]}"
         )
 
 
 _INCIDENT_HEADER = (
     f"{'label':<30}{'detector':<22}{'sev':<8}{'onset':>8}  "
-    f"{'config_hash':<14}{'algorithm':<18}message"
+    f"{'config_hash':<14}{'algorithm':<18}{'remediation':<12}message"
 )
 
 
@@ -233,6 +239,8 @@ def build_incident_index(root, **filters) -> list[IncidentRecord]:
         if not isinstance(blob, dict) or blob.get("kind") != "incident":
             continue
         cfg = blob.get("config") or {}
+        rem = blob.get("remediation")
+        rem = rem if isinstance(rem, dict) else {}
         rec = IncidentRecord(
             path=str(path),
             line=line,
@@ -244,6 +252,8 @@ def build_incident_index(root, **filters) -> list[IncidentRecord]:
             config_hash=blob.get("config_hash"),
             structural_hash=blob.get("structural_hash"),
             algorithm=cfg.get("algorithm") if isinstance(cfg, dict) else None,
+            remediation_policy=rem.get("policy"),
+            remediation_outcome=rem.get("outcome"),
         )
         if _matches(rec, filters):
             records.append(rec)
@@ -363,7 +373,24 @@ def compare_manifests(a: dict, b: dict) -> dict:
             }),
         }
 
+    def rem_outcomes(blob, h):
+        # Remediation outcomes visible on this side (ISSUE-16): a
+        # top-level block (comparing incident-bundle JSONL lines
+        # directly — the fleet-on vs fleet-off workflow), plus any
+        # carried by the health block's anomaly digests.
+        outs = []
+        rem = blob.get("remediation")
+        if isinstance(rem, dict) and rem.get("outcome"):
+            outs.append(str(rem["outcome"]))
+        inc = (h or {}).get("incidents") or {}
+        for an in inc.get("anomalies", []):
+            r = an.get("remediation") if isinstance(an, dict) else None
+            if isinstance(r, dict) and r.get("outcome"):
+                outs.append(str(r["outcome"]))
+        return sorted(outs)
+
     inc_a, inc_b = inc_block(ha), inc_block(hb)
+    rem_a, rem_b = rem_outcomes(a, ha), rem_outcomes(b, hb)
     return {
         "a": {"label": a.get("label") or a.get("artifact"),
               "config_hash": a.get("config_hash")},
@@ -393,6 +420,15 @@ def compare_manifests(a: dict, b: dict) -> dict:
             "detectors_only_in_a": sorted(
                 set(inc_a["detectors"]) - set(inc_b["detectors"])
             ),
+            # Fleet remediation-outcome delta (ISSUE-16): did the policy
+            # engine act, and did the two sides resolve differently?
+            "remediation": {
+                "a": rem_a,
+                "b": rem_b,
+                "delta_remediated": (
+                    rem_b.count("remediated") - rem_a.count("remediated")
+                ),
+            },
         },
     }
 
@@ -530,6 +566,19 @@ PERF_TOLERANCES: dict[str, tuple[Check, ...]] = {
               atol_floor=0.05),
         Check("divergence.onset_error_eval_windows", rtol=0.0,
               direction="max", atol_floor=2.0),
+    ),
+    "fleet.json": (
+        # The self-healing fleet soak (ISSUE-16): the boolean gates —
+        # every injected incident remediated (divergence halt +
+        # quarantine, dead-worker respawn, store-corruption quarantine
+        # + cold recompile), zero stuck requests, a full scale-up/
+        # scale-down cycle — must reproduce exactly; the warm-p99 SLO
+        # cell gets a generous ceiling envelope (shared CPU container,
+        # 2-3x session-to-session wall-clock variance).
+        Check("gates.*", equal=True, bool_only=True),
+        Check("latency.warm_p99_s", rtol=2.0, direction="max",
+              atol_floor=2.0),
+        Check("stuck_requests", equal=True),
     ),
 }
 
@@ -692,6 +741,18 @@ def _cmd_incidents(args) -> int:
         structural_hash=args.structural_hash,
         label=args.label,
     )
+    # Remediation-outcome filters (ISSUE-16): --remediated keeps bundles
+    # the fleet's policy engine resolved; --unremediated keeps the rest —
+    # failed/skipped outcomes AND bundles nothing acted on (those are
+    # the ones an operator still owes a response).
+    if getattr(args, "remediated", False):
+        records = [
+            r for r in records if r.remediation_outcome == "remediated"
+        ]
+    if getattr(args, "unremediated", False):
+        records = [
+            r for r in records if r.remediation_outcome != "remediated"
+        ]
     if args.json:
         print(json.dumps(
             [dataclasses.asdict(r) for r in records], indent=1,
@@ -742,6 +803,13 @@ def _cmd_compare(args) -> int:
                 "    fired only in A: "
                 + ", ".join(inc["detectors_only_in_a"])
             )
+    rem = inc["remediation"]
+    if rem["a"] or rem["b"]:
+        print(
+            f"  remediation: {rem['a'] or ['none']} vs "
+            f"{rem['b'] or ['none']} "
+            f"(remediated delta {rem['delta_remediated']:+d})"
+        )
     return 0
 
 
@@ -814,6 +882,15 @@ def main(argv=None) -> int:
                     choices=("info", "warn", "fatal"))
     pi.add_argument("--config-hash", default=None)
     pi.add_argument("--structural-hash", default=None)
+    rem_group = pi.add_mutually_exclusive_group()
+    rem_group.add_argument(
+        "--remediated", action="store_true",
+        help="only incidents the fleet's policy engine resolved "
+             "(remediation outcome 'remediated')")
+    rem_group.add_argument(
+        "--unremediated", action="store_true",
+        help="only incidents still owed a response (no remediation "
+             "block, or a failed/skipped outcome)")
     pi.add_argument("--label", default=None,
                     help="case-insensitive substring on the run label")
     pi.add_argument("--json", action="store_true")

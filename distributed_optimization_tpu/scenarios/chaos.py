@@ -32,6 +32,21 @@ Each injection increments the ``dopt_scenario_chaos_injections`` gauge
 (per-run reset, ``mode`` label). ``run_chaos_suite`` executes all modes
 and returns a JSON-safe record set with boolean gates — the block the
 golden corpus commits.
+
+Fleet chaos (ISSUE-16): a SECOND mode family proves the self-healing
+fleet's remediation policies and autoscaler close the detection→action
+loop — ``fleet_divergence_remediation`` (a planted over-budget ALIE
+attack mid-traffic: incident fires → offender halted with a
+policy-attributed error → its class quarantined for the tenant → healthy
+traffic untouched), ``fleet_store_remediation`` (a corrupted persistent
+store artifact under load: quarantined aside, recompiled cold, fresh
+artifact re-saved), ``fleet_worker_storm`` (SIGKILLs beyond the initial
+fleet size: every death requeued+respawned with remediation
+attribution), and ``fleet_autoscale_cycle`` (burst backlog → scale-up,
+idle → scale-down, fleet back at the floor). These run via
+``run_fleet_chaos_suite`` — deliberately NOT part of ``CHAOS_MODES`` so
+the golden scenario corpus (``examples/bench_scenarios.py``) is
+untouched; ``examples/bench_fleet.py`` commits their gates instead.
 """
 
 from __future__ import annotations
@@ -57,6 +72,13 @@ _log = get_logger("scenarios.chaos")
 CHAOS_MODES = (
     "poisoned_cohort", "daemon_kill_restart", "store_restart",
     "truncated_checkpoint", "broken_progress_callback",
+)
+# The self-healing-fleet family (module docstring): its own tuple and
+# suite so the default CHAOS_MODES — and the golden corpus gates built
+# on them — are byte-identical to PR 12.
+FLEET_CHAOS_MODES = (
+    "fleet_divergence_remediation", "fleet_store_remediation",
+    "fleet_worker_storm", "fleet_autoscale_cycle",
 )
 
 
@@ -521,5 +543,398 @@ def run_chaos_suite(
         "records": [r.to_dict() for r in records],
         "gates": {
             f"{r.mode}_graceful": bool(r.passed) for r in records
+        },
+    }
+
+
+# ------------------------------------------------------------ fleet modes
+
+
+def diverging_chaos_config(**overrides) -> ExperimentConfig:
+    """The planted f > b attack on the harness workload: ALIE with 3
+    attackers against a b=1 trimmed mean (per-neighborhood budget
+    exceeded) at a learning rate the attack-free twin converges under —
+    the same breakdown cell the anomaly sentinel's tests plant."""
+    fields: dict[str, Any] = dict(
+        n_iterations=300, eval_every=20, learning_rate_eta0=0.3,
+        attack="alie", n_byzantine=3, attack_scale=1.5,
+        aggregation="trimmed_mean", robust_b=1,
+    )
+    fields.update(overrides)
+    return default_chaos_config(**fields)
+
+
+def chaos_fleet_divergence(
+    *, incident_log: Optional[str] = None,
+) -> ChaosRecord:
+    """Planted over-budget attack mid-traffic: the divergence incident
+    fires, the ``divergence_halt_requeue`` policy halts the offender
+    with a policy-attributed error, quarantines its (tenant, structural
+    class) pair — a repeat submission sheds 429 ``quarantined`` — and
+    the healthy traffic sharing the service completes untouched."""
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.fleet import (
+        POLICY_DIVERGENCE,
+        FleetOptions,
+        RemediationEngine,
+    )
+    from distributed_optimization_tpu.serving.service import (
+        QueueFullError,
+        ServingOptions,
+        SimulationService,
+    )
+
+    service = SimulationService(
+        ServingOptions(window_s=0.0, progress_every=1),
+        cache=ExecutableCache(),
+    )
+    engine = RemediationEngine(FleetOptions(
+        quarantine_ttl_s=60.0, incident_log=incident_log,
+    )).attach(service)
+    detail: dict[str, Any] = {}
+    try:
+        healthy_cfg = default_chaos_config(dtype="float64")
+        healthy = [
+            service.submit(healthy_cfg.replace(learning_rate_eta0=eta))
+            for eta in (0.05, 0.08)
+        ]
+        attack_cfg = diverging_chaos_config()
+        attacker = service.submit(attack_cfg, tenant="attacker")
+        service.drain()
+        areq = service.result(attacker, timeout=300.0)
+        detail["attack_status"] = areq.status
+        detail["attack_error_attributed"] = _structured_error_ok(
+            areq.error, POLICY_DIVERGENCE
+        )
+        detail["attack_remediation_policy"] = (
+            (areq.remediation or {}).get("policy")
+        )
+        detail["healthy_statuses"] = [
+            service.result(r, timeout=60.0).status for r in healthy
+        ]
+        # The quarantine is live: the same class from the same tenant
+        # sheds with the machine-readable reason...
+        try:
+            service.submit(
+                attack_cfg.replace(seed=attack_cfg.seed + 1),
+                tenant="attacker",
+            )
+            detail["repeat_shed_reason"] = None
+        except QueueFullError as e:
+            detail["repeat_shed_reason"] = e.reason
+        # ... while OTHER tenants and other classes keep serving.
+        follow = service.submit(healthy_cfg)
+        service.drain()
+        detail["post_attack_status"] = service.result(
+            follow, timeout=60.0
+        ).status
+        st = engine.status()
+        detail["remediations_total"] = st["remediations"]["total"]
+        detail["active_quarantines"] = len(st["quarantines"])
+        passed = (
+            detail["attack_status"] == "failed"
+            and detail["attack_error_attributed"]
+            and detail["attack_remediation_policy"] == POLICY_DIVERGENCE
+            and all(s == "done" for s in detail["healthy_statuses"])
+            and detail["repeat_shed_reason"] == "quarantined"
+            and detail["post_attack_status"] == "done"
+            and detail["remediations_total"] >= 1
+            and detail["active_quarantines"] >= 1
+        )
+    finally:
+        service.close()
+    _chaos_gauge().set(1, mode="fleet_divergence_remediation")
+    return ChaosRecord("fleet_divergence_remediation", passed, detail)
+
+
+def chaos_fleet_store_corruption(
+    *, store_root: Optional[str] = None,
+    incident_log: Optional[str] = None,
+) -> ChaosRecord:
+    """Corrupted store artifact under load: incarnation A compiles cold
+    and writes through to disk; the artifact is gutted; incarnation B
+    (fresh cache, fleet attached) hits the corruption on load — the
+    ``store_corruption_quarantine`` policy renames it aside, the request
+    recompiles cold and completes, and the write-through path re-saves a
+    FRESH artifact at the original name."""
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.fleet import (
+        POLICY_STORE,
+        QUARANTINE_SUFFIX,
+        FleetOptions,
+        RemediationEngine,
+    )
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+    from distributed_optimization_tpu.serving.store import (
+        ARTIFACT_SUFFIX,
+        PersistentExecutableStore,
+    )
+
+    # A structural class no other mode compiles (distinct iteration
+    # count), so this store's artifact provably comes from here.
+    cfg = default_chaos_config(n_iterations=70)
+    own_dir = store_root is None
+    root = store_root or tempfile.mkdtemp(prefix="dopt-chaos-fleet-store-")
+    detail: dict[str, Any] = {"store_root": root}
+    passed = False
+    try:
+        # --- incarnation A: cold compile, write-through ----------------
+        svc_a = SimulationService(
+            ServingOptions(window_s=0.0),
+            cache=ExecutableCache(store=PersistentExecutableStore(root)),
+        )
+        rid = svc_a.submit(cfg)
+        svc_a.drain()
+        detail["first_status"] = svc_a.result(rid, timeout=300.0).status
+        svc_a.close()
+        artifacts = [
+            os.path.join(root, n) for n in os.listdir(root)
+            if n.endswith(ARTIFACT_SUFFIX)
+        ]
+        detail["artifacts_written"] = len(artifacts)
+        if not artifacts:
+            return ChaosRecord(
+                "fleet_store_remediation", False,
+                {**detail, "error": "no artifact written through"},
+            )
+        # --- gut the artifact ------------------------------------------
+        target = artifacts[0]
+        with open(target, "wb") as f:
+            f.write(b"chaos: not a pickle")
+        # --- incarnation B: fresh cache, fleet attached ----------------
+        svc_b = SimulationService(
+            ServingOptions(window_s=0.0),
+            cache=ExecutableCache(store=PersistentExecutableStore(root)),
+        )
+        engine = RemediationEngine(FleetOptions(
+            incident_log=incident_log,
+        )).attach(svc_b)
+        try:
+            rid = svc_b.submit(cfg)
+            svc_b.drain()
+            req = svc_b.result(rid, timeout=300.0)
+            detail["restart_status"] = req.status
+            detail["quarantined_artifact_exists"] = os.path.exists(
+                target + QUARANTINE_SUFFIX
+            )
+            # The cold recompile re-saved a fresh artifact at the
+            # ORIGINAL name through the existing write-through path.
+            detail["fresh_artifact_resaved"] = os.path.exists(target)
+            store_stats = svc_b.cache.stats().get("store") or {}
+            detail["store_corrupt_count"] = store_stats.get("corrupt")
+            recs = [
+                r for r in engine.status()["remediations"]["recent"]
+                if r["policy"] == POLICY_STORE
+            ]
+            detail["store_remediations"] = len(recs)
+            detail["store_outcomes"] = sorted(
+                {r["outcome"] for r in recs}
+            )
+            passed = (
+                detail["first_status"] == "done"
+                and detail["restart_status"] == "done"
+                and detail["quarantined_artifact_exists"]
+                and detail["fresh_artifact_resaved"]
+                and (detail["store_corrupt_count"] or 0) >= 1
+                and detail["store_remediations"] >= 1
+                and detail["store_outcomes"] == ["remediated"]
+            )
+        finally:
+            svc_b.close()
+    finally:
+        if own_dir:
+            shutil.rmtree(root, ignore_errors=True)
+    _chaos_gauge().set(1, mode="fleet_store_remediation")
+    return ChaosRecord("fleet_store_remediation", passed, detail)
+
+
+def chaos_fleet_worker_storm(*, n_kills: int = 2) -> ChaosRecord:
+    """SIGKILL storm matching the whole fleet: as many worker kills as
+    the pool has workers, injected while cohorts are in flight. Every
+    death must be requeued + respawned under the
+    ``dead_worker_respawn`` policy (with remediation attribution), and
+    every request must still complete."""
+    import signal
+
+    from distributed_optimization_tpu.serving.fleet import (
+        POLICY_WORKER,
+        RemediationEngine,
+    )
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    service = SimulationService(
+        ServingOptions(window_s=0.0, workers=2),
+    )
+    engine = RemediationEngine().attach(service)
+    detail: dict[str, Any] = {"kills": []}
+    try:
+        service.start()
+        # Distinct structural classes so the work spreads across both
+        # workers instead of coalescing into one cohort.
+        rids = [
+            service.submit(default_chaos_config(n_iterations=40 + 10 * i))
+            for i in range(4)
+        ]
+        # The pool is created lazily by the scheduler's first dispatch.
+        t0 = time.time()
+        while service._pool is None and time.time() - t0 < 60.0:
+            time.sleep(0.05)
+        pool = service._pool
+        if pool is None:
+            return ChaosRecord(
+                "fleet_worker_storm", False,
+                {**detail, "error": "worker pool never started"},
+            )
+        killed: set[int] = set()
+        deadline = time.time() + 300.0
+        while len(killed) < n_kills and time.time() < deadline:
+            if all(service.get(r).done.is_set() for r in rids):
+                break  # ran out of in-flight work to shoot at
+            victim = proc = None
+            with pool._lock:
+                for task in pool._tasks.values():
+                    wid = task.worker_id
+                    if wid is not None and wid not in killed:
+                        victim, proc = wid, pool._procs.get(wid)
+                        break
+            if victim is None or proc is None:
+                time.sleep(0.05)
+                continue
+            os.kill(proc.pid, signal.SIGKILL)
+            killed.add(victim)
+            detail["kills"].append(victim)
+            time.sleep(0.3)  # let the health monitor see the death
+        detail["n_killed"] = len(killed)
+        statuses = [
+            service.result(r, timeout=300.0).status for r in rids
+        ]
+        detail["statuses"] = statuses
+        st = engine.status()
+        worker_recs = [
+            r for r in st["remediations"]["recent"]
+            if r["policy"] == POLICY_WORKER
+        ]
+        detail["worker_remediations"] = len(worker_recs)
+        pst = pool.stats()
+        detail["pool_alive"] = pst["alive"]
+        detail["pool_restarts"] = pst["restarts"]
+        passed = (
+            detail["n_killed"] >= n_kills
+            and all(s == "done" for s in statuses)
+            and detail["worker_remediations"] >= n_kills
+            and detail["pool_alive"] == 2  # respawned back to strength
+            and detail["pool_restarts"] >= n_kills
+        )
+    finally:
+        service.close()
+    _chaos_gauge().set(1, mode="fleet_worker_storm")
+    return ChaosRecord("fleet_worker_storm", passed, detail)
+
+
+def chaos_fleet_autoscale(*, burst: int = 6) -> ChaosRecord:
+    """Burst backlog → scale-up, idle → scale-down: the queue-driven
+    autoscaler grows the worker fleet under a submission burst (within
+    its ceiling), drains the backlog, then retires back to the floor
+    once the service goes idle — retiring workers finishing their
+    in-flight cohorts first (the retire sentinel is only read between
+    tasks)."""
+    from distributed_optimization_tpu.serving.fleet import (
+        AutoscaleOptions,
+        QueueAutoscaler,
+    )
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    service = SimulationService(
+        # max_workers gives the dispatch executor headroom for the
+        # scaled-up fleet; the pool itself starts at ONE worker.
+        ServingOptions(window_s=0.0, workers=1, max_workers=4),
+    )
+    scaler = QueueAutoscaler(service, AutoscaleOptions(
+        min_workers=1, max_workers=2, high_depth=1, low_depth=0,
+        up_polls=2, down_polls=8, poll_s=0.1,
+    ))
+    detail: dict[str, Any] = {}
+    try:
+        service.start()
+        scaler.start()
+        # Distinct structural classes: no coalescing, a real backlog.
+        rids = [
+            service.submit(default_chaos_config(n_iterations=30 + 10 * i))
+            for i in range(burst)
+        ]
+        statuses = [
+            service.result(r, timeout=300.0).status for r in rids
+        ]
+        detail["statuses"] = statuses
+        detail["scale_ups"] = scaler.n_scale_up
+        # Idle now: wait (bounded) for the retire cycle to bottom out.
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            if (
+                scaler.n_scale_down >= 1
+                and service._pool.n_workers == 1
+                and service._pool.alive_count() == 1
+            ):
+                break
+            time.sleep(0.2)
+        detail["scale_downs"] = scaler.n_scale_down
+        pst = service._pool.stats()
+        detail["final_target"] = pst["workers"]
+        detail["final_alive"] = pst["alive"]
+        detail["retired"] = pst["retired"]
+        passed = (
+            all(s == "done" for s in statuses)
+            and detail["scale_ups"] >= 1
+            and detail["scale_downs"] >= 1
+            and detail["final_target"] == 1
+            and detail["final_alive"] == 1
+            and detail["retired"] >= 1
+        )
+    finally:
+        service.close()
+    _chaos_gauge().set(1, mode="fleet_autoscale_cycle")
+    return ChaosRecord("fleet_autoscale_cycle", passed, detail)
+
+
+def run_fleet_chaos_suite(
+    *, modes: tuple[str, ...] = FLEET_CHAOS_MODES,
+    incident_log: Optional[str] = None,
+) -> dict[str, Any]:
+    """Run the fleet chaos modes; same record/gate shape as
+    ``run_chaos_suite`` (the block ``docs/perf/fleet.json`` commits).
+    ``incident_log`` threads a JSONL path into the remediation modes so
+    the bench can assert the forensic stream end-to-end."""
+    runners = {
+        "fleet_divergence_remediation": lambda: chaos_fleet_divergence(
+            incident_log=incident_log
+        ),
+        "fleet_store_remediation": lambda: chaos_fleet_store_corruption(
+            incident_log=incident_log
+        ),
+        "fleet_worker_storm": lambda: chaos_fleet_worker_storm(),
+        "fleet_autoscale_cycle": lambda: chaos_fleet_autoscale(),
+    }
+    records = []
+    for mode in modes:
+        if mode not in runners:
+            raise ValueError(
+                f"unknown fleet chaos mode {mode!r} "
+                f"(valid: {FLEET_CHAOS_MODES})"
+            )
+        _log.info("fleet chaos: injecting %s", mode)
+        records.append(runners[mode]())
+    return {
+        "records": [r.to_dict() for r in records],
+        "gates": {
+            f"{r.mode}_remediated": bool(r.passed) for r in records
         },
     }

@@ -18,6 +18,18 @@ as ``(status, payload)`` for the caller to assert on, never retried
 (retrying a permanently invalid config would just hammer the daemon) and
 never raised as a bare traceback.
 
+The retry policy honors shed BLAME (ISSUE-16): a 429 whose structured
+``reason`` says the rejection is tenant-scoped (``tenant_cap`` — this
+tenant is at its own cap, or ``quarantined`` — this tenant's structural
+class is under a divergence quarantine) backs off
+``blame_backoff_factor`` times longer than a global-capacity 429 or a
+503, because other tenants are fine and hammering the daemon cannot make
+a tenant-scoped rejection clear faster. And a drain 503 is only
+transient until it isn't: the client confirms via one unretried
+``/v1/status`` probe, and once the daemon reports ``draining: true`` it
+stops retrying immediately (the drain precedes an exit; burning the rest
+of the backoff budget against it is pure wasted latency).
+
 Stdlib only (urllib), like the daemon itself. Used by the chaos harness
 (``scenarios/chaos.py``), ``examples/serve_smoke.py`` and
 ``examples/observatory_smoke.py``.
@@ -37,6 +49,9 @@ from distributed_optimization_tpu.log import get_logger
 _log = get_logger("serving.client")
 
 RETRYABLE_STATUSES = (429, 503)
+# Shed reasons that blame THIS tenant rather than global capacity: the
+# retry backs off longer on these (module docstring).
+TENANT_BLAME_REASONS = ("tenant_cap", "quarantined")
 
 
 class RetriesExhaustedError(ConnectionError):
@@ -64,6 +79,7 @@ class RetryingClient:
         max_retries: int = 5,
         backoff_s: float = 0.05,
         backoff_cap_s: float = 2.0,
+        blame_backoff_factor: float = 4.0,
         timeout_s: float = 300.0,
         seed: Optional[int] = None,
         sleep=time.sleep,
@@ -71,9 +87,15 @@ class RetryingClient:
         self.base_url = base_url.rstrip("/")
         if max_retries < 0:
             raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        if blame_backoff_factor < 1.0:
+            raise ValueError(
+                "blame_backoff_factor must be >= 1.0 (tenant-blamed sheds "
+                f"never back off SHORTER), got {blame_backoff_factor}"
+            )
         self.max_retries = max_retries
         self.backoff_s = backoff_s
         self.backoff_cap_s = backoff_cap_s
+        self.blame_backoff_factor = blame_backoff_factor
         self.timeout_s = timeout_s
         self._rng = random.Random(seed)
         self._sleep = sleep
@@ -104,6 +126,24 @@ class RetryingClient:
                 payload = {"error": "http_error", "detail": str(e)}
             return e.code, payload
 
+    def _confirmed_draining(self) -> bool:
+        """One UNRETRIED ``/v1/status`` probe after a drain 503: True
+        only when the daemon itself reports ``draining: true``. Any
+        probe failure returns False — benefit of the doubt, the normal
+        retry path keeps going (a restarting daemon also briefly answers
+        oddly, and that window IS worth retrying through)."""
+        try:
+            status, payload = self._once(
+                "GET", "/v1/status", None, min(self.timeout_s, 10.0),
+            )
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return False
+        return (
+            status == 200
+            and isinstance(payload, dict)
+            and bool(payload.get("draining"))
+        )
+
     def request(
         self, method: str, path: str, body=None,
         timeout: Optional[float] = None,
@@ -113,6 +153,7 @@ class RetryingClient:
         last_status: Optional[int] = None
         last_error: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
+            blame: Optional[str] = None
             try:
                 status, payload = self._once(method, path, body, timeout)
             except (urllib.error.URLError, ConnectionError, OSError) as e:
@@ -123,9 +164,31 @@ class RetryingClient:
                 if status not in RETRYABLE_STATUSES:
                     return status, payload
                 last_error, last_status = None, status
+                if isinstance(payload, dict):
+                    blame = payload.get("reason")
+                    if (
+                        status == 503
+                        and payload.get("error") == "draining"
+                        and self._confirmed_draining()
+                    ):
+                        # The daemon confirmed it is draining toward
+                        # shutdown: retries cannot land before the exit,
+                        # so stop burning the backoff budget now.
+                        raise RetriesExhaustedError(
+                            f"{method} {self.base_url + path} refused: "
+                            "daemon is draining toward shutdown "
+                            "(confirmed via /v1/status); not retrying",
+                            last_status=status,
+                        )
             if attempt == self.max_retries:
                 break
             delay = self._delay(attempt)
+            if blame in TENANT_BLAME_REASONS:
+                # The shed blames THIS tenant (its own cap, or a
+                # quarantined structural class) — other tenants are not
+                # throttled, so a fast retry only re-sheds. Back off
+                # longer (module docstring).
+                delay *= self.blame_backoff_factor
             self.n_retries += 1
             _log.debug(
                 "retrying %s %s after %s (attempt %d/%d, sleep %.3fs)",

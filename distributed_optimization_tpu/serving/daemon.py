@@ -397,6 +397,11 @@ class ServingDaemon:
             (host, port), self.service, socket_timeout_s=socket_timeout_s,
         )
         self._thread: Optional[threading.Thread] = None
+        # Optional fleet autoscaler (ISSUE-16): assigned before
+        # serve_forever()/start(), started once the service is up, and
+        # stopped by service.close() (which owns the ordering: autoscaler
+        # first, then the pool it scales).
+        self.autoscaler = None
 
     @property
     def address(self) -> tuple:
@@ -409,6 +414,8 @@ class ServingDaemon:
 
     def serve_forever(self) -> None:
         self.service.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         host, port = self.address
         _log.info("simulation service listening on http://%s:%s", host, port)
         try:
@@ -418,6 +425,8 @@ class ServingDaemon:
 
     def start(self) -> None:
         self.service.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             kwargs={"poll_interval": 0.1},
@@ -478,6 +487,25 @@ def main(argv=None) -> int:
                         "programs are serialized there and reloaded "
                         "across daemon restarts (0 compile seconds for "
                         "previously-served structural classes)")
+    p.add_argument("--fleet", action="store_true",
+                   help="enable the self-healing fleet remediation "
+                        "policies (divergence halt+requeue+quarantine, "
+                        "store-corruption quarantine, dead-worker "
+                        "respawn attribution); see docs/SERVING.md")
+    p.add_argument("--fleet-incidents", default=None, metavar="PATH",
+                   help="append remediated incidents (with their "
+                        "remediation blocks) to this JSONL file for "
+                        "`observatory incidents --remediated`; implies "
+                        "--fleet")
+    p.add_argument("--quarantine-ttl", type=float, default=300.0,
+                   help="seconds a (tenant, structural class) pair stays "
+                        "quarantined after a divergence incident")
+    p.add_argument("--autoscale-max", type=int, default=None,
+                   help="enable the queue-driven autoscaler with this "
+                        "worker ceiling (requires --workers >= 1; the "
+                        "initial --workers count is the starting fleet)")
+    p.add_argument("--autoscale-min", type=int, default=1,
+                   help="autoscaler worker floor (default 1)")
     p.add_argument("--port-file", default=None,
                    help="write the bound host:port here once listening "
                         "(for --port 0 orchestration: benches, smokes)")
@@ -524,6 +552,32 @@ def main(argv=None) -> int:
         ),
         socket_timeout_s=args.socket_timeout,
     )
+    if args.fleet or args.fleet_incidents:
+        from distributed_optimization_tpu.serving.fleet import (
+            FleetOptions,
+            RemediationEngine,
+        )
+
+        RemediationEngine(FleetOptions(
+            quarantine_ttl_s=args.quarantine_ttl,
+            incident_log=args.fleet_incidents,
+        )).attach(daemon.service)
+    if args.autoscale_max is not None:
+        if args.workers < 1:
+            p.error("--autoscale-max requires --workers >= 1 "
+                    "(an in-process service has nothing to scale)")
+        from distributed_optimization_tpu.serving.fleet import (
+            AutoscaleOptions,
+            QueueAutoscaler,
+        )
+
+        daemon.autoscaler = QueueAutoscaler(
+            daemon.service,
+            AutoscaleOptions(
+                min_workers=args.autoscale_min,
+                max_workers=args.autoscale_max,
+            ),
+        )
     if args.port_file:
         host, port = daemon.address
         tmp = args.port_file + ".tmp"

@@ -1,0 +1,731 @@
+"""Self-healing serving fleet (ISSUE-16 tentpole; docs/SERVING.md).
+
+PR 13's anomaly sentinel *detects* (a planted over-budget ALIE attack
+fires the divergence detector with a forensic incident bundle), and
+PR 15's serving plane *survives* (dead workers respawn, corrupt store
+artifacts degrade to cold compiles) — but nothing connected detection to
+action. This module closes the loop with two cooperating pieces:
+
+**RemediationEngine** — a policy table mapping incident classes to
+actions, each rule named and enable/disable-able:
+
+- ``divergence_halt_requeue``: a fatal divergence firing on a served
+  request halts that request at the cohort boundary (it fails with a
+  structured, policy-attributed error instead of returning a diverged
+  trajectory), requeues the cohort's sibling replicas that did NOT fire
+  for one clean re-run, and quarantines the offending structural class
+  for the submitting tenant (TTL-bounded) — further submissions of that
+  class shed with a machine-readable 429 ``reason="quarantined"``.
+- ``store_corruption_quarantine``: a corrupt executable-store artifact
+  is renamed aside (``*.quarantined``) so the next load is a clean miss
+  instead of re-reading the same damage; the cold recompile re-saves a
+  fresh artifact through the existing write-through path.
+- ``dead_worker_respawn``: the PR-15 requeue-orphans-and-respawn reflex,
+  folded into the same policy table — disabling the rule vetoes the
+  respawn (the pool shrinks instead), and every death is recorded with
+  the same remediation attribution as the other rules.
+
+Every action increments ``dopt_fleet_remediation_total{policy,outcome}``,
+appends a structured ``remediation`` block to the incident JSONL (when a
+log path is configured), and surfaces in ``/v1/status`` under ``fleet``.
+
+**QueueAutoscaler** — spawns/retires workers off the queue-depth and
+shed-rate signals the admission layer already publishes, with hysteresis
+bands (consecutive-poll streaks, not instantaneous thresholds) and hard
+min/max bounds. Drain-aware twice over: it never scales while the
+service drains, and a retiring worker finishes its in-flight cohort
+before exiting (the retire sentinel is only read between tasks — the
+PR-15 drain contract, per worker). Per-worker liveness gauges are
+republished wholesale-atomically every poll (``_Family.replace``), so a
+scale-down can never leave a stale worker label on the scrape surface.
+
+Everything here is stdlib-only (the serving daemon's constraint) and
+observation-driven: the engine never reaches into a running XLA program;
+it acts at the boundaries the serving plane already owns (admission,
+cohort completion, artifact load, worker death).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from distributed_optimization_tpu.log import get_logger
+from distributed_optimization_tpu.observability.metrics_registry import (
+    metrics_registry,
+)
+
+_log = get_logger("serving.fleet")
+
+# The policy table: every rule the engine knows, in evaluation order.
+POLICY_DIVERGENCE = "divergence_halt_requeue"
+POLICY_STORE = "store_corruption_quarantine"
+POLICY_WORKER = "dead_worker_respawn"
+FLEET_POLICIES = (POLICY_DIVERGENCE, POLICY_STORE, POLICY_WORKER)
+
+# Remediation outcomes (the metric label universe).
+OUTCOME_REMEDIATED = "remediated"
+OUTCOME_FAILED = "failed"
+OUTCOME_SKIPPED = "skipped_disabled"
+
+QUARANTINE_SUFFIX = ".quarantined"
+
+
+@dataclasses.dataclass
+class FleetOptions:
+    """Remediation-engine knobs (the daemon exposes them as flags).
+
+    ``policies``: the ENABLED rule names (subset of ``FLEET_POLICIES``);
+    a disabled rule records ``skipped_disabled`` instead of acting.
+    ``quarantine_ttl_s``: how long a (tenant, structural class) pair
+    stays quarantined after a divergence incident. ``incident_log``:
+    optional JSONL path remediated incidents (with their ``remediation``
+    blocks) are appended to — the forensic record ``observatory
+    incidents --remediated`` reads. ``max_records`` bounds the in-memory
+    remediation history ``/v1/status`` serves.
+    """
+
+    policies: tuple = FLEET_POLICIES
+    quarantine_ttl_s: float = 300.0
+    incident_log: Optional[str] = None
+    max_records: int = 256
+
+    def __post_init__(self) -> None:
+        unknown = set(self.policies) - set(FLEET_POLICIES)
+        if unknown:
+            raise ValueError(
+                f"unknown fleet policies {sorted(unknown)}; known policies "
+                f"are {list(FLEET_POLICIES)}"
+            )
+        if self.quarantine_ttl_s <= 0:
+            raise ValueError(
+                f"quarantine_ttl_s must be > 0, got {self.quarantine_ttl_s}"
+            )
+        if self.max_records < 1:
+            raise ValueError(
+                f"max_records must be >= 1, got {self.max_records}"
+            )
+
+
+class RemediationEngine:
+    """Incident → action policy engine (module docstring).
+
+    Thread-safe: the service's executor threads call ``review_plan``
+    concurrently, the store's load path calls ``on_store_corruption``
+    from worker-dispatch threads, and the pool's health monitor calls
+    ``on_worker_death`` — each mutation takes the engine's own leaf
+    locks, never the service lock.
+    """
+
+    def __init__(self, options: Optional[FleetOptions] = None):
+        self.options = options or FleetOptions()
+        self._policies = {
+            name: name in self.options.policies for name in FLEET_POLICIES
+        }
+        self._lock = threading.Lock()
+        # (tenant, structural_hash) -> monotonic expiry.
+        self._quarantine: dict[tuple, float] = {}
+        self.records: "deque[dict]" = deque(
+            maxlen=self.options.max_records
+        )
+        self.n_remediations = 0
+        self._service = None
+        reg = metrics_registry()
+        self._m_rem = reg.counter(
+            "dopt_fleet_remediation_total",
+            "Remediation-policy firings by policy and outcome "
+            "(remediated/failed/skipped_disabled)",
+        )
+        reg.gauge_fn(
+            "dopt_fleet_quarantined_classes",
+            "Structural classes currently quarantined (tenant-scoped, "
+            "TTL-bounded) by the divergence remediation policy",
+            self.quarantine_count,
+        )
+
+    # ---------------------------------------------------------- policy table
+    def enabled(self, policy: str) -> bool:
+        return bool(self._policies.get(policy))
+
+    def enable(self, policy: str) -> None:
+        self._check_policy(policy)
+        self._policies[policy] = True
+
+    def disable(self, policy: str) -> None:
+        self._check_policy(policy)
+        self._policies[policy] = False
+
+    @staticmethod
+    def _check_policy(policy: str) -> None:
+        if policy not in FLEET_POLICIES:
+            raise ValueError(
+                f"unknown fleet policy {policy!r}; known policies are "
+                f"{list(FLEET_POLICIES)}"
+            )
+
+    # -------------------------------------------------------------- wiring
+    def attach(self, service) -> "RemediationEngine":
+        """Bind this engine to a service: the service consults it at
+        admission (quarantine) and cohort completion (review), and the
+        engine hooks the service's store and worker pool. Returns self
+        for chaining."""
+        self._service = service
+        service.attach_fleet(self)
+        store = getattr(service.cache, "store", None)
+        if store is not None:
+            store.add_corruption_listener(self.on_store_corruption)
+        pool = getattr(service, "_pool", None)
+        if pool is not None:
+            pool.set_death_hook(self.on_worker_death)
+        return self
+
+    # ---------------------------------------------------------- quarantine
+    def quarantine(self, tenant: str, structural_hash: str) -> None:
+        with self._lock:
+            self._quarantine[(tenant, structural_hash)] = (
+                time.monotonic() + self.options.quarantine_ttl_s
+            )
+
+    def quarantine_count(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_quarantine(now)
+            return len(self._quarantine)
+
+    def active_quarantines(self) -> list[dict]:
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_quarantine(now)
+            return [
+                {
+                    "tenant": t, "structural_hash": h,
+                    "expires_in_s": round(exp - now, 1),
+                }
+                for (t, h), exp in sorted(self._quarantine.items())
+            ]
+
+    def _sweep_quarantine(self, now: float) -> None:
+        # Caller holds self._lock.
+        for key in [k for k, exp in self._quarantine.items() if exp <= now]:
+            del self._quarantine[key]
+
+    def quarantine_reason(self, config, tenant: str) -> Optional[str]:
+        """The admission-time check: a non-None return is the structured
+        shed detail for a (tenant, structural class) pair under an
+        active divergence quarantine."""
+        shash = config.structural_hash()
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_quarantine(now)
+            exp = self._quarantine.get((tenant, shash))
+        if exp is None:
+            return None
+        return (
+            f"structural class {shash[:12]} is quarantined for tenant "
+            f"{tenant!r} after a divergence incident "
+            f"({POLICY_DIVERGENCE}); retry in {exp - now:.0f}s or submit "
+            "a corrected config"
+        )
+
+    # -------------------------------------------------- divergence policy
+    def on_anomaly(self, req, anomaly) -> None:
+        """Live hook from the service's heartbeat path: a fatal
+        divergence quarantines the class MID-FLIGHT, so sibling traffic
+        of the same poisoned class sheds before the cohort even
+        finishes."""
+        if (
+            anomaly.detector == "divergence"
+            and anomaly.severity == "fatal"
+            and self.enabled(POLICY_DIVERGENCE)
+        ):
+            self.quarantine(req.tenant, req.config.structural_hash())
+
+    @staticmethod
+    def _fatal_divergence(req) -> bool:
+        return any(
+            i.get("detector") == "divergence"
+            and i.get("severity") == "fatal"
+            for i in req.incidents
+        )
+
+    def review_plan(self, plan, banks: dict) -> dict:
+        """Post-execution policy review of one completed plan; returns
+        ``{request_id: verdict}`` where a verdict is ``{"action":
+        "fail"|"requeue", "error", "remediation"}``. An empty dict means
+        the plan passes untouched (the overwhelmingly common case)."""
+        offenders = [r for r in plan.requests if self._fatal_divergence(r)]
+        if not offenders:
+            return {}
+        if not self.enabled(POLICY_DIVERGENCE):
+            self._record(
+                policy=POLICY_DIVERGENCE, trigger="divergence",
+                outcome=OUTCOME_SKIPPED,
+                actions=[],
+                detail={"offenders": [r.id for r in offenders]},
+            )
+            return {}
+        verdicts: dict[str, dict] = {}
+        offender_ids = {id(r) for r in offenders}  # identity, not __eq__
+        siblings = [
+            r for r in plan.requests if id(r) not in offender_ids
+        ]
+        requeue = [r for r in siblings if getattr(r, "requeues", 0) < 1]
+        for r in offenders:
+            shash = r.config.structural_hash()
+            self.quarantine(r.tenant, shash)
+            rem = {
+                "policy": POLICY_DIVERGENCE,
+                "trigger": "divergence",
+                "outcome": OUTCOME_REMEDIATED,
+                "actions": [
+                    "halt_offender",
+                    f"requeue_siblings:{len(requeue)}",
+                    "quarantine_class",
+                ],
+                "request_id": r.id,
+                "tenant": r.tenant,
+                "structural_hash": shash,
+                "quarantine_ttl_s": self.options.quarantine_ttl_s,
+            }
+            verdicts[r.id] = {
+                "action": "fail",
+                "error": (
+                    f"halted by fleet remediation ({POLICY_DIVERGENCE}): "
+                    "fatal divergence fired on this request; the diverged "
+                    "result is withheld, sibling replicas were requeued, "
+                    f"and structural class {shash[:12]} is quarantined "
+                    f"for tenant {r.tenant!r} "
+                    f"({self.options.quarantine_ttl_s:.0f}s TTL)"
+                ),
+                "remediation": rem,
+            }
+            self._record(
+                policy=POLICY_DIVERGENCE, trigger="divergence",
+                outcome=OUTCOME_REMEDIATED, actions=rem["actions"],
+                detail={
+                    "request_id": r.id, "tenant": r.tenant,
+                    "structural_hash": shash,
+                    "requeued_siblings": [s.id for s in requeue],
+                },
+            )
+            self._append_incidents(self._divergence_incidents(
+                r, banks.get(r.id), rem,
+            ))
+        for r in requeue:
+            verdicts[r.id] = {
+                "action": "requeue",
+                "error": (
+                    "sibling requeue shed by admission during "
+                    f"{POLICY_DIVERGENCE} remediation"
+                ),
+                "remediation": {
+                    "policy": POLICY_DIVERGENCE,
+                    "trigger": "divergence",
+                    "outcome": OUTCOME_REMEDIATED,
+                    "actions": ["requeued_sibling"],
+                    "offender": offenders[0].id,
+                },
+            }
+        return verdicts
+
+    def _divergence_incidents(self, req, bank, remediation) -> list[dict]:
+        """Forensic bundles for one offender: the bank's real divergence
+        anomalies when monitors ran, a synthesized operational record
+        otherwise — either way carrying the remediation block."""
+        incs: list[dict] = []
+        if bank is not None:
+            from distributed_optimization_tpu.observability.monitors import (
+                build_incident,
+            )
+
+            for a in bank.anomalies:
+                if a.detector == "divergence" and a.severity == "fatal":
+                    try:
+                        incs.append(build_incident(
+                            req.config, a, label=req.id,
+                            remediation=remediation,
+                        ))
+                    except Exception:
+                        _log.exception(
+                            "incident bundling failed for %s", req.id
+                        )
+        if not incs:
+            incs = [self._op_incident(
+                "divergence",
+                f"fatal divergence on served request {req.id}",
+                {"request_id": req.id, "tenant": req.tenant},
+                remediation,
+            )]
+        return incs
+
+    # ----------------------------------------------------- store policy
+    def on_store_corruption(self, path: str, detail: str) -> None:
+        """Store listener: quarantine the damaged artifact so the next
+        load of its key is a clean miss (the cold recompile re-saves a
+        fresh artifact through the existing write-through path)."""
+        if not self.enabled(POLICY_STORE):
+            self._record(
+                policy=POLICY_STORE, trigger="store_corruption",
+                outcome=OUTCOME_SKIPPED, actions=[],
+                detail={"artifact": path, "error": detail},
+            )
+            return
+        qpath = path + QUARANTINE_SUFFIX
+        outcome = OUTCOME_REMEDIATED
+        try:
+            os.replace(path, qpath)
+        except FileNotFoundError:
+            # Already moved (another listener/process won the race) —
+            # the artifact is out of the load path either way.
+            pass
+        except OSError as e:
+            outcome = OUTCOME_FAILED
+            detail = f"{detail}; quarantine rename failed: {e}"
+        rem = {
+            "policy": POLICY_STORE,
+            "trigger": "store_corruption",
+            "outcome": outcome,
+            "actions": ["quarantine_artifact", "recompile_cold"],
+            "artifact": path,
+            "quarantined_as": qpath,
+        }
+        self._record(
+            policy=POLICY_STORE, trigger="store_corruption",
+            outcome=outcome, actions=rem["actions"],
+            detail={"artifact": path, "error": detail},
+        )
+        self._append_incidents([self._op_incident(
+            "store_corruption",
+            f"corrupt executable-store artifact {path}: {detail}",
+            {"artifact": path, "quarantined_as": qpath},
+            rem,
+        )])
+
+    # ---------------------------------------------------- worker policy
+    def on_worker_death(self, worker_id: int, requeued: int,
+                        lost: int) -> bool:
+        """Pool death hook; the return value gates the respawn."""
+        if not self.enabled(POLICY_WORKER):
+            self._record(
+                policy=POLICY_WORKER, trigger="dead_worker",
+                outcome=OUTCOME_SKIPPED, actions=[],
+                detail={"worker": worker_id, "requeued": requeued,
+                        "lost": lost},
+            )
+            return False
+        rem = {
+            "policy": POLICY_WORKER,
+            "trigger": "dead_worker",
+            "outcome": OUTCOME_REMEDIATED,
+            "actions": [f"requeue_inflight:{requeued}", "respawn"],
+            "worker": worker_id,
+            "tasks_lost": lost,
+        }
+        self._record(
+            policy=POLICY_WORKER, trigger="dead_worker",
+            outcome=OUTCOME_REMEDIATED, actions=rem["actions"],
+            detail={"worker": worker_id, "requeued": requeued,
+                    "lost": lost},
+        )
+        self._append_incidents([self._op_incident(
+            "dead_worker",
+            f"worker {worker_id} died with {requeued + lost} task(s) in "
+            f"flight ({requeued} requeued, {lost} lost)",
+            {"worker": worker_id, "requeued": requeued, "lost": lost},
+            rem,
+        )])
+        return True
+
+    # ------------------------------------------------------------ records
+    def _record(self, *, policy, trigger, outcome, actions, detail) -> dict:
+        rec = {
+            "policy": policy,
+            "trigger": trigger,
+            "outcome": outcome,
+            "actions": list(actions),
+            "detail": detail,
+            "at_unix": time.time(),
+        }
+        with self._lock:
+            self.records.append(rec)
+            self.n_remediations += 1
+        self._m_rem.inc(policy=policy, outcome=outcome)
+        _log.info(
+            "remediation %s (%s) -> %s %s", policy, trigger, outcome,
+            actions,
+        )
+        return rec
+
+    def _op_incident(self, detector, message, evidence,
+                     remediation) -> dict:
+        """An operational incident bundle (no producing config — the
+        subject is the fleet itself) in the same schema the sentinel's
+        forensic bundles use, so one JSONL stream and one reader cover
+        both."""
+        from distributed_optimization_tpu.observability.monitors import (
+            INCIDENT_SCHEMA_VERSION,
+        )
+        from distributed_optimization_tpu.telemetry import provenance
+
+        return {
+            "schema_version": INCIDENT_SCHEMA_VERSION,
+            "kind": "incident",
+            "label": "fleet",
+            "detector": detector,
+            "severity": "warn",
+            "onset_iteration": 0,
+            "message": message,
+            "config": {},
+            "config_hash": None,
+            "structural_hash": None,
+            "evidence": evidence,
+            "context": {"kind": "operational"},
+            "provenance": provenance(),
+            "remediation": dict(remediation),
+        }
+
+    def _append_incidents(self, incidents: list[dict]) -> None:
+        path = self.options.incident_log
+        if not path or not incidents:
+            return
+        try:
+            from distributed_optimization_tpu.observability.monitors import (
+                write_incidents,
+            )
+
+            with self._lock:  # serialize appends across executor threads
+                write_incidents(path, incidents, append=True)
+        except Exception:
+            _log.exception("incident log append failed (%s)", path)
+
+    # ------------------------------------------------------------- status
+    def status(self) -> dict:
+        with self._lock:
+            recent = list(self.records)[-16:]
+            total = self.n_remediations
+        return {
+            "policies": dict(self._policies),
+            "quarantines": self.active_quarantines(),
+            "remediations": {"total": total, "recent": recent},
+            "incident_log": self.options.incident_log,
+        }
+
+
+# --------------------------------------------------------------- autoscaler
+
+
+@dataclasses.dataclass
+class AutoscaleOptions:
+    """Hysteresis bands and bounds for the queue-driven autoscaler.
+
+    Depth is the service's visible BACKLOG: undispatched queued requests
+    plus worker-pool tasks beyond one-per-worker (dispatch moves work
+    from the first bucket to the second without shrinking it).
+
+    Pressure (backlog above ``high_depth``, or ANY admission shed
+    since the last poll) must persist for ``up_polls`` consecutive polls
+    before one worker is added; idleness (depth at/below ``low_depth``
+    with nothing in flight) must persist for ``down_polls`` polls before
+    one worker retires. The asymmetry is deliberate: scale-up chases a
+    visible backlog, scale-down waits out a lull. Between the bands the
+    streaks reset — the classic hysteresis dead zone.
+    """
+
+    min_workers: int = 1
+    max_workers: int = 4
+    high_depth: int = 8
+    low_depth: int = 0
+    up_polls: int = 2
+    down_polls: int = 20
+    poll_s: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.min_workers < 1:
+            raise ValueError(
+                f"min_workers must be >= 1, got {self.min_workers}"
+            )
+        if self.max_workers < self.min_workers:
+            raise ValueError(
+                f"max_workers ({self.max_workers}) must be >= min_workers "
+                f"({self.min_workers})"
+            )
+        if self.low_depth < 0 or self.high_depth <= self.low_depth:
+            raise ValueError(
+                f"need high_depth > low_depth >= 0, got "
+                f"{self.high_depth}/{self.low_depth}"
+            )
+        if self.up_polls < 1 or self.down_polls < 1:
+            raise ValueError("up_polls and down_polls must be >= 1")
+        if self.poll_s <= 0:
+            raise ValueError(f"poll_s must be > 0, got {self.poll_s}")
+
+
+class QueueAutoscaler:
+    """Queue-driven worker autoscaling (module docstring).
+
+    ``decide`` is the pure policy core (unit-testable without processes);
+    ``poll_once`` reads the live signals and executes the decision;
+    ``start`` runs ``poll_once`` on a background thread every
+    ``poll_s``."""
+
+    def __init__(self, service, options: Optional[AutoscaleOptions] = None):
+        if service.options.workers < 1:
+            raise ValueError(
+                "the autoscaler needs a worker-pool service "
+                "(ServingOptions.workers >= 1); an in-process service "
+                "has nothing to scale"
+            )
+        self.service = service
+        service._autoscaler = self  # surfaces in service.stats()["fleet"]
+        self.options = options or AutoscaleOptions()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._up_streak = 0
+        self._idle_streak = 0
+        self._last_shed: Optional[int] = None
+        self.n_scale_up = 0
+        self.n_scale_down = 0
+        self.events: "deque[dict]" = deque(maxlen=256)
+        reg = metrics_registry()
+        self._m_events = reg.counter(
+            "dopt_fleet_scale_events_total",
+            "Autoscaler worker fleet changes, by direction (up/down)",
+        )
+        self._m_target = reg.gauge(
+            "dopt_fleet_workers_target",
+            "Worker fleet size the autoscaler is currently targeting",
+        )
+        self._m_worker_up = reg.gauge(
+            "dopt_fleet_worker_up",
+            "Per-worker fleet membership (1 = in the fleet); the whole "
+            "label set is replaced atomically every poll, so retired "
+            "workers' series vanish instead of going stale",
+        )
+
+    # ------------------------------------------------------------- policy
+    def decide(self, *, depth: int, shed_delta: int, target: int,
+               in_flight: int, draining: bool) -> int:
+        """One poll's scaling decision: +1, -1 or 0. Mutates the
+        hysteresis streaks; never scales while draining (streaks reset —
+        a drain must end in a quiet fleet, not a rescaled one)."""
+        o = self.options
+        if draining:
+            self._up_streak = self._idle_streak = 0
+            return 0
+        pressured = depth > o.high_depth or shed_delta > 0
+        idle = depth <= o.low_depth and in_flight == 0
+        if pressured:
+            self._up_streak += 1
+            self._idle_streak = 0
+        elif idle:
+            self._idle_streak += 1
+            self._up_streak = 0
+        else:  # the dead zone between the bands: hold, reset both
+            self._up_streak = self._idle_streak = 0
+        if self._up_streak >= o.up_polls and target < o.max_workers:
+            self._up_streak = 0
+            return 1
+        if self._idle_streak >= o.down_polls and target > o.min_workers:
+            self._idle_streak = 0
+            return -1
+        return 0
+
+    # ------------------------------------------------------------ execution
+    def poll_once(self) -> int:
+        """Read the live signals, decide, act; returns the applied delta."""
+        svc = self.service
+        svc._ensure_workers()
+        pool = svc._pool
+        if pool is None:  # workers >= 1 guaranteed by __init__
+            return 0
+        shed_total = int(svc._queue.stats()["shed"])
+        shed_delta = (
+            0 if self._last_shed is None
+            else max(0, shed_total - self._last_shed)
+        )
+        self._last_shed = shed_total
+        pst = pool.stats()
+        # The WFQ queue drains into the pool's task queue at dispatch
+        # time, so the visible backlog is BOTH: undispatched requests
+        # plus pool tasks beyond one-per-worker (oversubscription).
+        backlog = svc.queue_depth() + max(
+            0, pst["in_flight"] - pst["workers"]
+        )
+        delta = self.decide(
+            depth=backlog,
+            shed_delta=shed_delta,
+            target=pst["workers"],
+            in_flight=pst["in_flight"],
+            draining=svc.draining,
+        )
+        if delta > 0:
+            new_ids = pool.scale_up(1)
+            self.n_scale_up += 1
+            self._m_events.inc(direction="up")
+            self.events.append({
+                "direction": "up", "workers": pool.n_workers,
+                "spawned": new_ids, "at_unix": time.time(),
+            })
+            _log.info("autoscaler: +1 worker -> %d", pool.n_workers)
+        elif delta < 0:
+            pool.scale_down(1)
+            self.n_scale_down += 1
+            self._m_events.inc(direction="down")
+            self.events.append({
+                "direction": "down", "workers": pool.n_workers,
+                "at_unix": time.time(),
+            })
+            _log.info("autoscaler: -1 worker -> %d", pool.n_workers)
+        self._m_target.set(pool.n_workers)
+        self._m_worker_up.replace(
+            ({"worker": str(w)}, 1.0) for w in pool.worker_ids()
+        )
+        return delta
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Bring the fleet to ``min_workers`` and start polling."""
+        svc = self.service
+        svc._ensure_workers()
+        pool = svc._pool
+        if pool is not None and pool.n_workers < self.options.min_workers:
+            pool.scale_up(self.options.min_workers - pool.n_workers)
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.options.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # pragma: no cover - belt and braces
+                _log.exception("autoscaler poll failed; continuing")
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------------- status
+    def status(self) -> dict:
+        pool = self.service._pool
+        return {
+            "min_workers": self.options.min_workers,
+            "max_workers": self.options.max_workers,
+            "high_depth": self.options.high_depth,
+            "low_depth": self.options.low_depth,
+            "target": pool.n_workers if pool is not None else None,
+            "scale_ups": self.n_scale_up,
+            "scale_downs": self.n_scale_down,
+            "recent_events": list(self.events)[-16:],
+        }

@@ -158,6 +158,11 @@ class ServingOptions:
     tenant_weights: Optional[dict] = None
     cut_budget: Optional[int] = None
     workers: int = 0
+    # Autoscaling headroom (ISSUE-16): the dispatch executor is sized to
+    # this many threads (None = ``workers``), so a fleet the autoscaler
+    # grows past the initial ``workers`` can actually receive that many
+    # concurrent plans — thread pools cannot be resized after the fact.
+    max_workers: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.progress_every < 1:
@@ -185,6 +190,13 @@ class ServingOptions:
         if self.workers < 0:
             raise ValueError(
                 f"workers must be >= 0, got {self.workers}"
+            )
+        if self.max_workers is not None and self.max_workers < max(
+            self.workers, 1
+        ):
+            raise ValueError(
+                f"max_workers ({self.max_workers}) must be >= "
+                f"workers ({self.workers}) and >= 1"
             )
 
 
@@ -225,6 +237,11 @@ class Request:
     # Anomaly-sentinel firings observed on this request's heartbeats
     # (ISSUE-13): compact anomaly dicts, appended live as detectors fire.
     incidents: list = dataclasses.field(default_factory=list)
+    # Fleet remediation (ISSUE-16): what the policy engine did about this
+    # request (halt/requeue attribution), and how many times remediation
+    # requeued it (bounded — one clean re-run per sibling).
+    remediation: Optional[dict] = None
+    requeues: int = 0
 
     def status_dict(self) -> dict:
         """The JSON-safe view the daemon returns for status polls."""
@@ -246,6 +263,8 @@ class Request:
                 }
                 for i in self.incidents
             ]
+        if self.remediation is not None:
+            out["remediation"] = self.remediation
         if self.status in (DONE, FAILED):
             out["serving"] = self.serving_block()
         return out
@@ -341,6 +360,12 @@ class SimulationService:
         # a plain in-process service never spawns anything.
         self._pool = None
         self._executor = None
+        # Fleet reflexes (ISSUE-16): the remediation engine consulted at
+        # admission (quarantine) and cohort completion (review), and the
+        # autoscaler that registered against this service — both None on
+        # a plain service, and both attach from serving/fleet.py.
+        self._fleet = None
+        self._autoscaler = None
         self._gauge_lock = threading.Lock()
         self._gauge_tenants: set[str] = set()
         self._requests: dict[str, Request] = {}
@@ -402,6 +427,18 @@ class SimulationService:
             "Requests pending in the serving queue, per tenant",
         )
 
+    # -------------------------------------------------------------- fleet
+    def attach_fleet(self, engine) -> None:
+        """Bind a ``RemediationEngine`` (serving/fleet.py): submissions
+        check its quarantine table, live anomalies feed it, completed
+        plans pass through its policy review, and a lazily-built worker
+        pool inherits its death hook. Callers use ``engine.attach(
+        service)``, which also wires the store listener."""
+        self._fleet = engine
+        pool = self._pool
+        if pool is not None:
+            pool.set_death_hook(engine.on_worker_death)
+
     # ---------------------------------------------------------- submission
     def submit(self, config, *, tenant=None, priority=None) -> str:
         """Validate and enqueue one request; returns its id.
@@ -422,6 +459,18 @@ class SimulationService:
             # Re-raise as the structured 400 the daemon already maps —
             # a malformed tenant field is a bad request, not a 500.
             raise ServingError(str(e)) from e
+        fleet = self._fleet
+        if fleet is not None:
+            # Quarantine check (ISSUE-16): a (tenant, structural class)
+            # pair under an active divergence quarantine sheds with a
+            # machine-readable reason before touching the queue — the
+            # same 429 + Retry-After contract the caps speak.
+            qreason = fleet.quarantine_reason(cfg, tenant)
+            if qreason is not None:
+                self._m_shed.inc(reason="quarantined", tenant=tenant)
+                raise QueueFullError(
+                    qreason, reason="quarantined", tenant=tenant,
+                )
         shed: Optional[ShedLoad] = None
         with self._lock:
             if self._draining:
@@ -558,10 +607,20 @@ class SimulationService:
                     WorkerPool,
                 )
 
-                self._pool = WorkerPool(self.options.workers)
+                fleet = self._fleet
+                self._pool = WorkerPool(
+                    self.options.workers,
+                    on_worker_death=(
+                        fleet.on_worker_death if fleet is not None else None
+                    ),
+                )
                 self._pool.start()
                 self._executor = ThreadPoolExecutor(
-                    max_workers=self.options.workers,
+                    # Autoscaling headroom: size the dispatch width to the
+                    # fleet ceiling, not the initial fleet (ISSUE-16).
+                    max_workers=(
+                        self.options.max_workers or self.options.workers
+                    ),
                     thread_name_prefix="serving-dispatch",
                 )
             return self._executor
@@ -683,6 +742,15 @@ class SimulationService:
                 req.incidents.append(anomaly.to_dict())
                 with self._lock:
                     self.n_incidents += 1
+                fleet = self._fleet
+                if fleet is not None:
+                    try:
+                        # Live remediation hook (ISSUE-16): e.g. a fatal
+                        # divergence quarantines its structural class
+                        # MID-FLIGHT, before the cohort finishes.
+                        fleet.on_anomaly(req, anomaly)
+                    except Exception:
+                        _log.exception("fleet anomaly hook failed")
                 req.progress.publish(ProgressEvent(
                     kind="anomaly",
                     iteration=int(anomaly.onset_iteration),
@@ -800,14 +868,12 @@ class SimulationService:
             self.queue_waits.extend(
                 r.queue_wait_s for r in plan.requests
             )
-            self.n_done += plan.size
             if plan.sequential_reason is not None:
                 self.n_sequential += plan.size
             for name, secs in plan_tracer.phases.items():
                 self.tracer.phases[name] = (
                     self.tracer.phases.get(name, 0.0) + secs
                 )
-        self._m_requests.inc(plan.size, status="done")
         self._m_cohort_size.observe(plan.size)
         self._m_queue_wait.observe_many(
             [r.queue_wait_s for r in plan.requests]
@@ -846,12 +912,84 @@ class SimulationService:
                 if jax_cached_path else None
             )
             req.run_wall_s = wall
+        # Fleet policy review (ISSUE-16): with an engine attached, a
+        # fatal incident can override the default "everything completed
+        # is DONE" — the offender fails with a policy-attributed error,
+        # its innocent cohort siblings requeue for one clean re-run.
+        verdicts: dict = {}
+        fleet = self._fleet
+        if fleet is not None:
+            try:
+                verdicts = fleet.review_plan(plan, banks)
+            except Exception:
+                _log.exception("fleet plan review failed; serving as-is")
+                verdicts = {}
+        n_done_now = n_failed_now = 0
+        for req, res in zip(plan.requests, results):
+            verdict = verdicts.get(req.id)
+            if verdict is not None:
+                req.remediation = verdict.get("remediation")
+                if verdict["action"] == "requeue" and (
+                    self._requeue_for_remediation(req)
+                ):
+                    continue  # back in the queue; not finished
+                # "fail", or a requeue the admission layer shed:
+                req.result = None
+                req.status = FAILED
+                req.error = verdict.get("error") or (
+                    "failed by fleet remediation policy"
+                )
+                n_failed_now += 1
+                self._finish(req)
+                continue
             req.manifest = self._manifest(
                 req, res, spans=plan_tracer.chrome_events(),
-                bank=bank,
+                bank=banks.get(req.id),
             )
             req.status = DONE
+            n_done_now += 1
             self._finish(req)
+        with self._lock:
+            self.n_done += n_done_now
+            self.n_failed += n_failed_now
+        if n_done_now:
+            self._m_requests.inc(n_done_now, status="done")
+        if n_failed_now:
+            self._m_requests.inc(n_failed_now, status="failed")
+
+    def _requeue_for_remediation(self, req: Request) -> bool:
+        """Push a cohort sibling back into the queue for a clean re-run
+        (fleet policy action). Returns False when admission sheds the
+        requeue — the caller then fails the request structurally instead
+        of leaving it stuck."""
+        shed = None
+        with self._lock:
+            req.requeues += 1
+            req.status = QUEUED
+            req.worker = None
+            req.result = None
+            req.cache_hit = None
+            try:
+                self._queue.push(
+                    req, tenant=req.tenant, priority=req.priority,
+                )
+            except ShedLoad as e:
+                shed = e
+            else:
+                req.progress.publish(ProgressEvent(
+                    kind="lifecycle", iteration=0,
+                    n_iterations=req.config.n_iterations,
+                    wall_seconds=req.run_wall_s or 0.0,
+                    status=QUEUED,
+                    extra={"requeued_by": "fleet", "attempt":
+                           req.requeues + 1},
+                ))
+        if shed is not None:
+            self._m_shed.inc(reason=shed.reason, tenant=shed.tenant)
+            return False
+        self._publish_tenant_depths()
+        self._wake.set()
+        return True
 
     def _finish(self, req: Request) -> None:
         """Mark a request finished and rotate the bounded history: beyond
@@ -935,6 +1073,10 @@ class SimulationService:
     def close(self) -> None:
         """Stop the scheduler loop (pending work stays queued) and tear
         down the worker plane when one was spawned."""
+        autoscaler = self._autoscaler
+        if autoscaler is not None:
+            # The autoscaler must stop BEFORE the pool it scales dies.
+            autoscaler.stop()
         self._stop.set()
         self._wake.set()
         thread = self._thread
@@ -983,6 +1125,21 @@ class SimulationService:
         }
         pool = self._pool
         workers_stats = pool.stats() if pool is not None else None
+        # Fleet block (ISSUE-16): remediation-policy state + autoscaler
+        # summary when attached, None on a plain service — computed
+        # outside the service lock (both have their own leaf locks).
+        fleet_block = None
+        if self._fleet is not None or self._autoscaler is not None:
+            fleet_block = {
+                "remediation": (
+                    self._fleet.status() if self._fleet is not None
+                    else None
+                ),
+                "autoscaler": (
+                    self._autoscaler.status()
+                    if self._autoscaler is not None else None
+                ),
+            }
         with self._lock:
             admission["inflight"] = self._inflight
             draining = self._draining
@@ -998,6 +1155,7 @@ class SimulationService:
                 "draining": draining,
                 "admission": admission,
                 "workers": workers_stats,
+                "fleet": fleet_block,
                 "requests_total": self._counter,
                 "requests_done": self.n_done,
                 "requests_failed": self.n_failed,

@@ -133,6 +133,12 @@ class PersistentExecutableStore:
         self._stats = StoreStats()
         self._warned: set[str] = set()  # one warning per artifact file
         self._provenance: Optional[dict] = None  # resolved on first use
+        # Corruption observers (ISSUE-16 fleet remediation): called as
+        # ``fn(path, detail)`` when an artifact reads as corrupt. The
+        # store itself only degrades to a miss; a listener may choose to
+        # quarantine the file so the next load is a clean miss instead of
+        # re-reading the same damage.
+        self._corruption_listeners: list = []
         # Registry families (ISSUE-10 conventions): labeled result
         # counter so a dashboard separates warm loads from provenance
         # skips without scraping logs.
@@ -169,6 +175,23 @@ class PersistentExecutableStore:
                 return
             self._warned.add(path)
         _log.warning("%s — falling back to a cold compile", message)
+
+    def add_corruption_listener(self, fn) -> None:
+        """Register ``fn(path, detail)`` to run when an artifact reads as
+        corrupt (truncated pickle, schema/key mismatch, undeserializable
+        payload). Listener failures are swallowed — remediation must
+        never break the degrade-to-miss contract."""
+        with self._lock:
+            self._corruption_listeners.append(fn)
+
+    def _notify_corrupt(self, path: str, detail: str) -> None:
+        with self._lock:
+            listeners = list(self._corruption_listeners)
+        for fn in listeners:
+            try:
+                fn(path, detail)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- writing
     def save(self, key: tuple, entry) -> bool:
@@ -267,6 +290,7 @@ class PersistentExecutableStore:
                 f"corrupt/unreadable store artifact {path} "
                 f"({type(e).__name__}: {e})",
             )
+            self._notify_corrupt(path, f"{type(e).__name__}: {e}")
             return None
         stored_prov = record.get("provenance") or {}
         here = self._prov()
@@ -305,6 +329,7 @@ class PersistentExecutableStore:
                 f"could not deserialize store artifact {path} "
                 f"({type(e).__name__}: {e})",
             )
+            self._notify_corrupt(path, f"{type(e).__name__}: {e}")
             return None
         load_s = time.perf_counter() - t0
         with self._lock:

@@ -201,6 +201,14 @@ def _worker_main(worker_id: int, task_q, result_q, env: dict) -> None:
         task = task_q.get()
         if task is None:
             break
+        if task.get("__retire__"):
+            # Autoscaler scale-down (ISSUE-16): the retire sentinel is
+            # only ever picked up BETWEEN tasks, so a retiring worker has
+            # by construction finished its in-flight cohort — the drain
+            # contract, *per worker*. Exactly one worker consumes each
+            # sentinel; it announces and exits.
+            result_q.put(("retired", worker_id))
+            break
         task_id = task["task_id"]
         result_q.put(("start", task_id, worker_id))
 
@@ -251,12 +259,17 @@ class WorkerPool:
         *,
         env: Optional[dict] = None,
         max_task_attempts: int = MAX_TASK_ATTEMPTS,
+        on_worker_death=None,
     ):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
-        self.n_workers = n_workers
+        self.n_workers = n_workers  # current TARGET size (scale ops move it)
         self.env = dict(env or {})
         self.max_task_attempts = max_task_attempts
+        # Fleet hook (ISSUE-16): called as fn(worker_id, requeued, lost)
+        # when a worker dies unexpectedly; returns whether to respawn.
+        # None keeps the PR-15 behavior: always respawn.
+        self._on_death = on_worker_death
         self._ctx = None
         self._task_q = None
         self._result_q = None
@@ -264,11 +277,14 @@ class WorkerPool:
         self._tasks: dict[int, _Task] = {}
         self._lock = threading.Lock()
         self._counter = 0
+        self._next_wid = n_workers  # fresh ids for scale-up spawns
+        self._pending_retires = 0
         self._stop = threading.Event()
         self._router: Optional[threading.Thread] = None
         self._monitor: Optional[threading.Thread] = None
         self.n_restarts = 0
         self.n_requeues = 0
+        self.n_retired = 0
         from distributed_optimization_tpu.observability.metrics_registry import (  # noqa: E501
             metrics_registry,
         )
@@ -342,6 +358,67 @@ class WorkerPool:
     def alive_count(self) -> int:
         return sum(1 for p in self._procs.values() if p.is_alive())
 
+    def worker_ids(self) -> list[int]:
+        """Ids of the workers currently in the fleet (retired ones are
+        gone) — the autoscaler's per-worker gauge label universe."""
+        with self._lock:
+            return sorted(self._procs)
+
+    # --------------------------------------------------------------- scaling
+    def scale_up(self, k: int = 1) -> list[int]:
+        """Spawn ``k`` additional workers (fresh ids, never reusing a
+        retired id — label series stay unambiguous); returns the new ids.
+        Requires a started pool."""
+        if self._router is None:
+            raise RuntimeError("scale_up on a pool that was never started")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        new_ids = []
+        with self._lock:
+            for _ in range(k):
+                wid = self._next_wid
+                self._next_wid += 1
+                new_ids.append(wid)
+            self.n_workers += k
+        for wid in new_ids:
+            self._spawn(wid)
+        return new_ids
+
+    def scale_down(self, k: int = 1) -> None:
+        """Retire ``k`` workers gracefully: a retire sentinel is posted
+        on the shared task queue per retirement, and whichever worker
+        picks one up finishes its in-flight cohort first (the sentinel is
+        only read between tasks), announces, and exits. Never drops the
+        target below 1 — a zero-worker pool cannot serve."""
+        if self._router is None:
+            raise RuntimeError("scale_down on a pool that was never started")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        with self._lock:
+            if self.n_workers - k < 1:
+                raise ValueError(
+                    f"scale_down({k}) would leave {self.n_workers - k} "
+                    "workers; the pool floor is 1"
+                )
+            self.n_workers -= k
+            self._pending_retires += k
+        for _ in range(k):
+            self._task_q.put({"__retire__": True})
+
+    def _finish_retirement(self, worker_id: int) -> None:
+        """Idempotent bookkeeping for a retired worker — reached from the
+        router (the announced path) or the health monitor (announcement
+        lost); whichever pops the proc record wins."""
+        with self._lock:
+            proc = self._procs.pop(worker_id, None)
+            if proc is None:
+                return
+            self._pending_retires = max(0, self._pending_retires - 1)
+            self.n_retired += 1
+        proc.join(timeout=5.0)
+        if proc.is_alive():
+            proc.terminate()
+
     # ------------------------------------------------------------ dispatching
     def run_plan(
         self, plan, progress_handler, *, progress_every: int = 1,
@@ -388,6 +465,9 @@ class WorkerPool:
                 continue
             kind = msg[0]
             if kind == "ready":
+                continue
+            if kind == "retired":
+                self._finish_retirement(msg[1])
                 continue
             if kind == "start":
                 _, task_id, worker_id = msg
@@ -438,6 +518,16 @@ class WorkerPool:
                         t for t in self._tasks.values()
                         if t.worker_id == wid and not t.done.is_set()
                     ]
+                    pending_retire = self._pending_retires > 0
+                if not orphans and pending_retire:
+                    # A clean exit with retirements outstanding is almost
+                    # certainly a retiring worker whose announcement the
+                    # router has not drained yet — fold it into the
+                    # retirement path (idempotent) instead of respawning
+                    # a worker the autoscaler just asked to go away.
+                    self._finish_retirement(wid)
+                    continue
+                n_requeued = n_lost = 0
                 for task in orphans:
                     if task.attempts >= self.max_task_attempts:
                         task.error = (
@@ -446,17 +536,35 @@ class WorkerPool:
                             f"{self.max_task_attempts}); giving up"
                         )
                         self._m_tasks.inc(worker=str(wid), result="lost")
+                        n_lost += 1
                         task.done.set()
                     else:
                         task.attempts += 1
                         task.worker_id = None
                         self.n_requeues += 1
+                        n_requeued += 1
                         self._m_tasks.inc(
                             worker=str(wid), result="requeued")
                         self._task_q.put(task.payload)
-                self.n_restarts += 1
-                self._m_restarts.inc(worker=str(wid))
-                self._spawn(wid)
+                respawn = True
+                if self._on_death is not None:
+                    try:
+                        respawn = bool(self._on_death(wid, n_requeued,
+                                                      n_lost))
+                    except Exception:
+                        respawn = True  # a broken policy must not strand
+                if respawn:
+                    self.n_restarts += 1
+                    self._m_restarts.inc(worker=str(wid))
+                    self._spawn(wid)
+                else:
+                    # Policy vetoed the respawn (dead_worker rule
+                    # disabled): drop the record so the monitor does not
+                    # re-detect the same corpse every poll, and shrink
+                    # the target to match reality.
+                    with self._lock:
+                        self._procs.pop(wid, None)
+                        self.n_workers = max(1, self.n_workers - 1)
 
     # ------------------------------------------------------------- telemetry
     def stats(self) -> dict:
@@ -468,4 +576,10 @@ class WorkerPool:
             "in_flight": in_flight,
             "restarts": int(self.n_restarts),
             "requeues": int(self.n_requeues),
+            "retired": int(self.n_retired),
         }
+
+    def set_death_hook(self, fn) -> None:
+        """(Re)attach the dead-worker policy hook after construction —
+        how a fleet engine binds to a pool the service built lazily."""
+        self._on_death = fn

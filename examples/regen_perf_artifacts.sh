@@ -29,6 +29,7 @@ python examples/bench_telemetry.py         # -> docs/perf/telemetry.json (overhe
 python examples/bench_fused_robust.py      # -> docs/perf/fused_robust.json (compiled-path floor gated)
 python examples/bench_serving.py           # -> docs/perf/serving.json (latency/throughput floors gated)
 python examples/bench_serving_load.py      # -> docs/perf/serving_load.json (sustained-load warm-p99/saturation/fairness floors + restart-warm + shed gates; multi-worker daemon + persistent store)
+python examples/bench_fleet.py            # -> docs/perf/fleet.json (self-healing soak: every injected incident remediated + zero stuck + autoscale cycle gated; fleet reflex layer over the multi-worker daemon)
 python examples/bench_observatory.py       # -> docs/perf/observatory.json (heartbeat-overhead ceiling incl. async segment-fused cell + /metrics scrape gated)
 python examples/bench_monitors.py          # -> docs/perf/monitors.json (anomaly-sentinel overhead/onset/halt gated)
 python examples/bench_federated.py         # -> docs/perf/federated.json (floats-to-eps floor + N=10k completion gated)

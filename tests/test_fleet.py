@@ -1,0 +1,928 @@
+"""Self-healing serving fleet (ISSUE-16): remediation-policy engine
+semantics, queue-driven autoscaler hysteresis, wholesale gauge
+replacement (no stale worker labels), admission × drain interactions,
+blame-aware client retries, and the slow chaos-gated end-to-end modes.
+
+The fast tests here pin the POLICY layer with shims (no processes, no
+compiles); the ``slow``-marked chaos tests and ``examples/bench_fleet.py``
+prove the same policies end-to-end against real workers and real
+incidents.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+from conftest import small_backend_config as small_config
+
+from distributed_optimization_tpu.observability.metrics_registry import (
+    metrics_registry,
+)
+from distributed_optimization_tpu.serving.fleet import (
+    FLEET_POLICIES,
+    OUTCOME_REMEDIATED,
+    OUTCOME_SKIPPED,
+    POLICY_DIVERGENCE,
+    POLICY_STORE,
+    POLICY_WORKER,
+    QUARANTINE_SUFFIX,
+    AutoscaleOptions,
+    FleetOptions,
+    QueueAutoscaler,
+    RemediationEngine,
+)
+
+
+# --------------------------------------------------------------- shims
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics, like Request
+class _Req:
+    id: str
+    config: object
+    tenant: str = "default"
+    priority: str = "normal"
+    incidents: list = dataclasses.field(default_factory=list)
+    requeues: int = 0
+
+
+@dataclasses.dataclass(eq=False)
+class _Plan:
+    requests: list
+
+
+def _fatal_divergence_incident():
+    return {"detector": "divergence", "severity": "fatal",
+            "onset_iteration": 120, "message": "gap blew up"}
+
+
+def _cfg(**kw):
+    defaults = dict(n_iterations=20, eval_every=10, n_samples=160,
+                    local_batch_size=16, dtype="float64")
+    defaults.update(kw)
+    return small_config(**defaults)
+
+
+# ------------------------------------------------------- policy table
+
+
+def test_policy_table_defaults_and_toggle():
+    eng = RemediationEngine()
+    assert all(eng.enabled(p) for p in FLEET_POLICIES)
+    eng.disable(POLICY_STORE)
+    assert not eng.enabled(POLICY_STORE)
+    assert eng.enabled(POLICY_DIVERGENCE)
+    eng.enable(POLICY_STORE)
+    assert eng.enabled(POLICY_STORE)
+    with pytest.raises(ValueError, match="unknown fleet policy"):
+        eng.enable("reboot_universe")
+    # Construction with a subset enables exactly that subset.
+    eng2 = RemediationEngine(FleetOptions(policies=(POLICY_WORKER,)))
+    assert eng2.enabled(POLICY_WORKER)
+    assert not eng2.enabled(POLICY_DIVERGENCE)
+
+
+def test_fleet_options_validation():
+    with pytest.raises(ValueError, match="unknown fleet policies"):
+        FleetOptions(policies=("nope",))
+    with pytest.raises(ValueError, match="quarantine_ttl_s"):
+        FleetOptions(quarantine_ttl_s=0.0)
+    with pytest.raises(ValueError, match="max_records"):
+        FleetOptions(max_records=0)
+
+
+# -------------------------------------------------- divergence policy
+
+
+def test_review_plan_halts_offender_requeues_siblings_quarantines():
+    eng = RemediationEngine()
+    cfg = _cfg()
+    offender = _Req("r-bad", cfg, tenant="acme",
+                    incidents=[_fatal_divergence_incident()])
+    fresh_sib = _Req("r-sib", cfg, tenant="acme")
+    tired_sib = _Req("r-old", cfg, tenant="acme", requeues=1)
+    plan = _Plan([offender, fresh_sib, tired_sib])
+    before = metrics_registry().counter(
+        "dopt_fleet_remediation_total"
+    ).value(policy=POLICY_DIVERGENCE, outcome=OUTCOME_REMEDIATED)
+
+    verdicts = eng.review_plan(plan, banks={})
+
+    v = verdicts["r-bad"]
+    assert v["action"] == "fail"
+    assert POLICY_DIVERGENCE in v["error"]
+    rem = v["remediation"]
+    assert rem["policy"] == POLICY_DIVERGENCE
+    assert rem["outcome"] == OUTCOME_REMEDIATED
+    assert "halt_offender" in rem["actions"]
+    assert "quarantine_class" in rem["actions"]
+    # The fresh sibling requeues once; the already-requeued one is left
+    # alone (bounded retries — no requeue ping-pong).
+    assert verdicts["r-sib"]["action"] == "requeue"
+    assert verdicts["r-sib"]["remediation"]["offender"] == "r-bad"
+    assert "r-old" not in verdicts
+    # The offender's (tenant, structural class) pair is quarantined —
+    # for THAT tenant only.
+    assert eng.quarantine_reason(cfg, "acme") is not None
+    assert eng.quarantine_reason(cfg, "other-tenant") is None
+    assert metrics_registry().counter(
+        "dopt_fleet_remediation_total"
+    ).value(policy=POLICY_DIVERGENCE, outcome=OUTCOME_REMEDIATED) == (
+        before + 1
+    )
+
+
+def test_review_plan_clean_plan_returns_no_verdicts():
+    eng = RemediationEngine()
+    plan = _Plan([_Req("r-ok", _cfg())])
+    assert eng.review_plan(plan, banks={}) == {}
+    assert eng.n_remediations == 0
+
+
+def test_review_plan_disabled_policy_records_skip():
+    eng = RemediationEngine()
+    eng.disable(POLICY_DIVERGENCE)
+    plan = _Plan([_Req("r-bad", _cfg(),
+                       incidents=[_fatal_divergence_incident()])])
+    assert eng.review_plan(plan, banks={}) == {}
+    rec = eng.records[-1]
+    assert rec["policy"] == POLICY_DIVERGENCE
+    assert rec["outcome"] == OUTCOME_SKIPPED
+    # Skipping acts on nothing: no quarantine either.
+    assert eng.quarantine_reason(_cfg(), "default") is None
+
+
+def test_quarantine_ttl_expires():
+    eng = RemediationEngine(FleetOptions(quarantine_ttl_s=0.05))
+    cfg = _cfg()
+    eng.quarantine("acme", cfg.structural_hash())
+    assert eng.quarantine_count() == 1
+    assert eng.quarantine_reason(cfg, "acme") is not None
+    time.sleep(0.08)
+    assert eng.quarantine_reason(cfg, "acme") is None
+    assert eng.quarantine_count() == 0
+
+
+def test_on_anomaly_quarantines_mid_flight():
+    eng = RemediationEngine()
+    cfg = _cfg()
+    req = SimpleNamespace(config=cfg, tenant="acme")
+    eng.on_anomaly(req, SimpleNamespace(
+        detector="divergence", severity="fatal"
+    ))
+    assert eng.quarantine_reason(cfg, "acme") is not None
+    # Non-fatal and non-divergence anomalies do NOT quarantine.
+    eng2 = RemediationEngine()
+    eng2.on_anomaly(req, SimpleNamespace(
+        detector="divergence", severity="warn"
+    ))
+    eng2.on_anomaly(req, SimpleNamespace(
+        detector="consensus_stall", severity="fatal"
+    ))
+    assert eng2.quarantine_reason(cfg, "acme") is None
+
+
+# ------------------------------------------------------- store policy
+
+
+def test_store_corruption_quarantines_artifact(tmp_path):
+    artifact = tmp_path / "deadbeef.dopt-exec"
+    artifact.write_bytes(b"garbage")
+    eng = RemediationEngine()
+    eng.on_store_corruption(str(artifact), "UnpicklingError: truncated")
+    assert not artifact.exists()
+    assert (tmp_path / ("deadbeef.dopt-exec" + QUARANTINE_SUFFIX)).exists()
+    rec = eng.records[-1]
+    assert rec["policy"] == POLICY_STORE
+    assert rec["outcome"] == OUTCOME_REMEDIATED
+    assert "quarantine_artifact" in rec["actions"]
+
+
+def test_store_corruption_disabled_leaves_artifact(tmp_path):
+    artifact = tmp_path / "deadbeef.dopt-exec"
+    artifact.write_bytes(b"garbage")
+    eng = RemediationEngine(FleetOptions(
+        policies=(POLICY_DIVERGENCE, POLICY_WORKER),
+    ))
+    eng.on_store_corruption(str(artifact), "boom")
+    assert artifact.exists()  # untouched: the policy is off
+    assert eng.records[-1]["outcome"] == OUTCOME_SKIPPED
+
+
+def test_store_corruption_tolerates_lost_race(tmp_path):
+    # Another listener/process already moved it: still remediated (the
+    # artifact is out of the load path either way).
+    eng = RemediationEngine()
+    eng.on_store_corruption(str(tmp_path / "gone.dopt-exec"), "boom")
+    assert eng.records[-1]["outcome"] == OUTCOME_REMEDIATED
+
+
+# ------------------------------------------------------ worker policy
+
+
+def test_worker_death_policy_gates_respawn():
+    eng = RemediationEngine()
+    assert eng.on_worker_death(3, requeued=1, lost=0) is True
+    rec = eng.records[-1]
+    assert rec["policy"] == POLICY_WORKER
+    assert rec["outcome"] == OUTCOME_REMEDIATED
+    assert "respawn" in rec["actions"]
+
+    eng.disable(POLICY_WORKER)
+    assert eng.on_worker_death(4, requeued=0, lost=1) is False
+    assert eng.records[-1]["outcome"] == OUTCOME_SKIPPED
+
+
+def test_incident_log_carries_remediation_blocks(tmp_path):
+    from distributed_optimization_tpu.observability.monitors import (
+        read_incidents,
+    )
+
+    log = tmp_path / "fleet.incidents.jsonl"
+    eng = RemediationEngine(FleetOptions(incident_log=str(log)))
+    eng.on_worker_death(0, requeued=2, lost=0)
+    eng.on_store_corruption(str(tmp_path / "x.dopt-exec"), "boom")
+    incs = read_incidents(log)
+    assert len(incs) == 2
+    assert {i["detector"] for i in incs} == {
+        "dead_worker", "store_corruption"
+    }
+    for inc in incs:
+        assert inc["kind"] == "incident"
+        assert inc["label"] == "fleet"
+        assert inc["context"] == {"kind": "operational"}
+        assert inc["remediation"]["outcome"] == OUTCOME_REMEDIATED
+
+
+def test_build_incident_remediation_block_optional():
+    """``build_incident`` with/without a remediation block: readers
+    predating the fleet see the exact old schema."""
+    from distributed_optimization_tpu.observability.monitors import (
+        Anomaly,
+        build_incident,
+    )
+
+    cfg = _cfg()
+    anomaly = Anomaly("divergence", "fatal", 120, "gap blew up", {})
+    plain = build_incident(cfg, anomaly, label="x")
+    assert "remediation" not in plain
+    tagged = build_incident(
+        cfg, anomaly, label="x",
+        remediation={"policy": POLICY_DIVERGENCE, "outcome": "remediated"},
+    )
+    assert tagged["remediation"]["policy"] == POLICY_DIVERGENCE
+    # Identical apart from the added block.
+    tagged.pop("remediation")
+    assert tagged == plain
+
+
+def test_engine_status_shape():
+    eng = RemediationEngine()
+    eng.on_worker_death(1, requeued=0, lost=0)
+    st = eng.status()
+    assert set(st) == {
+        "policies", "quarantines", "remediations", "incident_log",
+    }
+    assert st["policies"] == {p: True for p in FLEET_POLICIES}
+    assert st["remediations"]["total"] == 1
+    assert st["remediations"]["recent"][-1]["policy"] == POLICY_WORKER
+
+
+def test_fleet_metric_families_render():
+    RemediationEngine()  # registration is enough; no traffic needed
+    text = metrics_registry().render()
+    assert "# TYPE dopt_fleet_remediation_total counter" in text
+    assert "# TYPE dopt_fleet_quarantined_classes gauge" in text
+
+
+# --------------------------------------------------- autoscaler policy
+
+
+def _stub_service(workers=1):
+    return SimpleNamespace(
+        options=SimpleNamespace(workers=workers), _autoscaler=None,
+    )
+
+
+def _scaler(**kw):
+    return QueueAutoscaler(_stub_service(), AutoscaleOptions(**kw))
+
+
+def test_autoscaler_requires_worker_service():
+    with pytest.raises(ValueError, match="nothing to scale"):
+        QueueAutoscaler(_stub_service(workers=0))
+
+
+def test_autoscale_options_validation():
+    with pytest.raises(ValueError, match="min_workers"):
+        AutoscaleOptions(min_workers=0)
+    with pytest.raises(ValueError, match="max_workers"):
+        AutoscaleOptions(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError, match="high_depth"):
+        AutoscaleOptions(high_depth=0, low_depth=0)
+    with pytest.raises(ValueError, match="up_polls"):
+        AutoscaleOptions(up_polls=0)
+    with pytest.raises(ValueError, match="poll_s"):
+        AutoscaleOptions(poll_s=0.0)
+
+
+def test_decide_up_needs_consecutive_pressure():
+    s = _scaler(high_depth=2, up_polls=2)
+    kw = dict(shed_delta=0, target=1, in_flight=1, draining=False)
+    assert s.decide(depth=5, **kw) == 0  # first pressured poll: streak 1
+    assert s.decide(depth=5, **kw) == 1  # second: scale up
+    # The streak reset with the decision: pressure must re-accumulate.
+    assert s.decide(depth=5, **kw) == 0
+
+
+def test_decide_shed_counts_as_pressure():
+    s = _scaler(high_depth=8, up_polls=2)
+    kw = dict(target=1, in_flight=0, draining=False)
+    assert s.decide(depth=0, shed_delta=3, **kw) == 0
+    assert s.decide(depth=0, shed_delta=1, **kw) == 1
+
+
+def test_decide_dead_zone_resets_streaks():
+    s = _scaler(high_depth=4, low_depth=0, up_polls=2)
+    kw = dict(shed_delta=0, target=1, draining=False)
+    assert s.decide(depth=9, in_flight=1, **kw) == 0
+    # Between the bands (depth 2, work in flight): hold AND reset.
+    assert s.decide(depth=2, in_flight=1, **kw) == 0
+    assert s.decide(depth=9, in_flight=1, **kw) == 0  # streak restarted
+    assert s.decide(depth=9, in_flight=1, **kw) == 1
+
+
+def test_decide_down_after_sustained_idle_respects_floor():
+    s = _scaler(min_workers=1, max_workers=4, down_polls=3)
+    idle = dict(depth=0, shed_delta=0, in_flight=0, draining=False)
+    assert s.decide(target=2, **idle) == 0
+    assert s.decide(target=2, **idle) == 0
+    assert s.decide(target=2, **idle) == -1
+    # At the floor, idleness accumulates but never retires below it.
+    for _ in range(6):
+        assert s.decide(target=1, **idle) == 0
+
+
+def test_decide_respects_ceiling():
+    s = _scaler(max_workers=2, high_depth=1, up_polls=1)
+    kw = dict(shed_delta=0, in_flight=2, draining=False)
+    assert s.decide(depth=9, target=1, **kw) == 1
+    assert s.decide(depth=9, target=2, **kw) == 0  # at max: hold
+
+
+def test_decide_never_scales_while_draining():
+    """Satellite: the autoscaler observing a DRAINING queue must not
+    spawn, no matter how deep the backlog — and the drain also resets
+    any accumulated streaks."""
+    s = _scaler(high_depth=1, up_polls=2, down_polls=1)
+    live = dict(shed_delta=0, target=1, in_flight=1, draining=False)
+    assert s.decide(depth=50, **live) == 0  # streak primed
+    assert s.decide(depth=50, shed_delta=5, target=1, in_flight=1,
+                    draining=True) == 0
+    assert s.decide(depth=0, shed_delta=0, target=3, in_flight=0,
+                    draining=True) == 0  # nor retire
+    # Post-drain, the primed streak is gone: pressure re-accumulates.
+    assert s.decide(depth=50, **live) == 0
+    assert s.decide(depth=50, **live) == 1
+
+
+# ----------------------------------------------- poll_once (fake pool)
+
+
+class _FakePool:
+    def __init__(self):
+        self.n_workers = 1
+        self._ids = [0]
+        self._next = 1
+        self.in_flight = 0
+
+    def stats(self):
+        return {"workers": self.n_workers, "alive": len(self._ids),
+                "in_flight": self.in_flight, "restarts": 0,
+                "requeues": 0, "retired": 0}
+
+    def scale_up(self, k=1):
+        new = list(range(self._next, self._next + k))
+        self._next += k
+        self._ids.extend(new)
+        self.n_workers += k
+        return new
+
+    def scale_down(self, k=1):
+        for _ in range(k):
+            self._ids.pop()
+            self.n_workers -= 1
+
+    def worker_ids(self):
+        return list(self._ids)
+
+
+class _FakeQueueService:
+    def __init__(self):
+        self.options = SimpleNamespace(workers=1)
+        self._autoscaler = None
+        self._pool = _FakePool()
+        self._queue = SimpleNamespace(stats=lambda: {"shed": self.shed})
+        self.shed = 0
+        self.depth = 0
+        self.draining = False
+
+    def _ensure_workers(self):
+        pass
+
+    def queue_depth(self):
+        return self.depth
+
+
+def test_poll_once_scales_up_down_and_republishes_worker_gauge():
+    svc = _FakeQueueService()
+    scaler = QueueAutoscaler(svc, AutoscaleOptions(
+        min_workers=1, max_workers=2, high_depth=1, low_depth=0,
+        up_polls=2, down_polls=2,
+    ))
+    gauge = metrics_registry().gauge("dopt_fleet_worker_up")
+
+    svc.depth = 6
+    assert scaler.poll_once() == 0
+    assert scaler.poll_once() == 1  # hysteresis satisfied: +1 worker
+    assert svc._pool.n_workers == 2
+    assert scaler.n_scale_up == 1
+    assert gauge.value(worker="0") == 1.0
+    assert gauge.value(worker="1") == 1.0
+
+    # Oversubscribed pool counts as backlog even with the queue empty.
+    svc.depth = 0
+    svc._pool.in_flight = 6
+    scaler2_delta = scaler.poll_once()
+    assert scaler2_delta == 0  # at the ceiling: hold
+
+    # Idle long enough: retire, and the retired worker's gauge series
+    # VANISHES from the scrape surface (wholesale replace, satellite).
+    svc._pool.in_flight = 0
+    assert scaler.poll_once() == 0
+    assert scaler.poll_once() == -1
+    assert svc._pool.n_workers == 1
+    assert scaler.n_scale_down == 1
+    rendered = metrics_registry().render()
+    assert 'dopt_fleet_worker_up{worker="0"} 1' in rendered
+    assert 'worker="1"' not in rendered.split(
+        "# TYPE dopt_fleet_worker_up gauge"
+    )[1].split("# TYPE")[0]
+    assert metrics_registry().gauge(
+        "dopt_fleet_workers_target"
+    ).value() == 1.0
+
+
+def test_poll_once_holds_while_draining():
+    """Satellite (poll path): a draining service never scales, even
+    with a deep backlog and a primed streak."""
+    svc = _FakeQueueService()
+    scaler = QueueAutoscaler(svc, AutoscaleOptions(
+        min_workers=1, max_workers=4, high_depth=1, up_polls=1,
+    ))
+    svc.depth = 50
+    svc.draining = True
+    for _ in range(5):
+        assert scaler.poll_once() == 0
+    assert svc._pool.n_workers == 1
+    assert scaler.n_scale_up == 0
+
+
+def test_autoscaler_status_and_events():
+    svc = _FakeQueueService()
+    scaler = QueueAutoscaler(svc, AutoscaleOptions(
+        high_depth=1, up_polls=1, max_workers=3,
+    ))
+    svc.depth = 9
+    scaler.poll_once()
+    st = scaler.status()
+    assert st["target"] == 2
+    assert st["scale_ups"] == 1
+    assert st["recent_events"][-1]["direction"] == "up"
+    assert svc._autoscaler is scaler  # surfaces in service stats
+
+
+# ------------------------------------------- gauge replace (satellite)
+
+
+def test_gauge_replace_is_wholesale():
+    reg = metrics_registry()
+    fam = reg.gauge("dopt_test_fleet_replace_gauge", "replace test")
+    fam.set(1.0, worker="0")
+    fam.set(1.0, worker="1")
+    fam.set(1.0, worker="2")
+    fam.replace([({"worker": "0"}, 1.0), ({"worker": "3"}, 0.5)])
+    assert fam.value(worker="0") == 1.0
+    assert fam.value(worker="3") == 0.5
+    # Stale series are GONE, not zeroed.
+    text = reg.render()
+    block = text.split("# TYPE dopt_test_fleet_replace_gauge gauge")[1]
+    block = block.split("# TYPE")[0] if "# TYPE" in block else block
+    assert 'worker="1"' not in block
+    assert 'worker="2"' not in block
+    fam.replace([])
+    assert fam.value(worker="0") == 0.0
+
+
+def test_gauge_replace_rejects_non_gauges():
+    reg = metrics_registry()
+    with pytest.raises(TypeError, match="not a gauge"):
+        reg.counter("dopt_test_fleet_replace_counter").replace([])
+    with pytest.raises(TypeError, match="not a gauge"):
+        reg.histogram("dopt_test_fleet_replace_hist").replace([])
+
+
+# -------------------------------------------- admission × drain (svc)
+
+
+def _service(**opt_kw):
+    from distributed_optimization_tpu.serving.cache import ExecutableCache
+    from distributed_optimization_tpu.serving.service import (
+        ServingOptions,
+        SimulationService,
+    )
+
+    return SimulationService(
+        ServingOptions(window_s=0.0, **opt_kw), cache=ExecutableCache(),
+    )
+
+
+def test_queued_low_priority_completes_through_drain():
+    """Satellite: low-priority work queued just before ``begin_drain``
+    still completes — a drain finishes accepted work regardless of its
+    scheduling weight."""
+    from distributed_optimization_tpu.serving.service import DrainingError
+
+    service = _service()
+    try:
+        cfg = _cfg()
+        accepted = [
+            service.submit(cfg.replace(seed=s), tenant="batch",
+                           priority="low")
+            for s in (1, 2)
+        ]
+        service.begin_drain()
+        with pytest.raises(DrainingError):
+            service.submit(cfg.replace(seed=3), tenant="batch",
+                           priority="low")
+        service.process_once()
+        assert service.wait_drained(timeout=60.0)
+        for rid in accepted:
+            req = service.result(rid, timeout=60.0)
+            assert req.status == "done"
+    finally:
+        service.close()
+
+
+def test_service_stats_fleet_block():
+    service = _service()
+    try:
+        assert service.stats()["fleet"] is None
+        engine = RemediationEngine().attach(service)
+        st = service.stats()["fleet"]
+        assert st["remediation"]["policies"] == {
+            p: True for p in FLEET_POLICIES
+        }
+        assert st["autoscaler"] is None
+        assert engine is service._fleet
+    finally:
+        service.close()
+
+
+def test_quarantined_submission_sheds_with_reason():
+    from distributed_optimization_tpu.serving.service import QueueFullError
+
+    service = _service()
+    try:
+        engine = RemediationEngine().attach(service)
+        cfg = _cfg()
+        engine.quarantine("acme", cfg.structural_hash())
+        with pytest.raises(QueueFullError) as ei:
+            service.submit(cfg, tenant="acme")
+        assert ei.value.reason == "quarantined"
+        assert ei.value.tenant == "acme"
+        # Other tenants submit the same class freely.
+        rid = service.submit(cfg, tenant="bob")
+        service.drain()
+        assert service.result(rid, timeout=120.0).status == "done"
+    finally:
+        service.close()
+
+
+def test_fleet_requeue_path_reruns_request():
+    """The service's requeue machinery end-to-end: a forced 'requeue'
+    verdict on the first pass sends the request back through the queue
+    and the SECOND pass completes it (requeue accounting + lifecycle
+    event included)."""
+    service = _service()
+    engine = RemediationEngine().attach(service)
+    passes = {"n": 0}
+    real_review = engine.review_plan
+
+    def review_once(plan, banks):
+        passes["n"] += 1
+        if passes["n"] == 1:
+            return {
+                plan.requests[0].id: {
+                    "action": "requeue",
+                    "error": "test-forced requeue",
+                    "remediation": {
+                        "policy": POLICY_DIVERGENCE,
+                        "outcome": OUTCOME_REMEDIATED,
+                        "actions": ["requeued_sibling"],
+                        "offender": "r-elsewhere",
+                    },
+                },
+            }
+        return real_review(plan, banks)
+
+    engine.review_plan = review_once
+    try:
+        rid = service.submit(_cfg())
+        service.drain()
+        req = service.result(rid, timeout=120.0)
+        assert req.status == "done"
+        assert req.requeues == 1
+        assert passes["n"] >= 2
+        events = [e for e in req.progress.events()
+                  if (e.get("extra") or {}).get("requeued_by") == "fleet"]
+        assert len(events) == 1
+        assert service.stats()["requests_done"] >= 1
+    finally:
+        service.close()
+
+
+def test_divergence_remediation_end_to_end():
+    """The tentpole loop against a REAL planted attack (the anomaly
+    sentinel's f > b ALIE cell): incident fires → offender halted with a
+    policy-attributed error and a ``remediation`` block in its status →
+    class quarantined for the tenant → healthy traffic unaffected."""
+    from distributed_optimization_tpu.serving.service import QueueFullError
+
+    service = _service(progress_every=1)
+    try:
+        engine = RemediationEngine().attach(service)
+        attack = small_config(
+            n_iterations=300, eval_every=20, learning_rate_eta0=0.3,
+            attack="alie", n_byzantine=3, attack_scale=1.5,
+            aggregation="trimmed_mean", robust_b=1,
+        )
+        rid = service.submit(attack, tenant="acme")
+        service.drain()
+        req = service.result(rid, timeout=300.0)
+        assert req.status == "failed"
+        assert POLICY_DIVERGENCE in (req.error or "")
+        assert "Traceback" not in (req.error or "")
+        sd = req.status_dict()
+        assert sd["remediation"]["policy"] == POLICY_DIVERGENCE
+        assert sd["remediation"]["outcome"] == OUTCOME_REMEDIATED
+        # Quarantined for the submitting tenant; shed is attributed.
+        with pytest.raises(QueueFullError) as ei:
+            service.submit(attack.replace(seed=9), tenant="acme")
+        assert ei.value.reason == "quarantined"
+        # The fleet block tells the whole story in /v1/status shape.
+        fleet = service.stats()["fleet"]["remediation"]
+        assert fleet["remediations"]["total"] >= 1
+        assert fleet["quarantines"][0]["tenant"] == "acme"
+        # Healthy traffic still serves.
+        ok = service.submit(_cfg(), tenant="acme")
+        service.drain()
+        assert service.result(ok, timeout=120.0).status == "done"
+    finally:
+        service.close()
+
+
+# ----------------------------------------------- client blame backoff
+
+
+def _sleep_recorder():
+    sleeps = []
+    return sleeps, sleeps.append
+
+
+def _client_with_canned(status, payload, sleeps_append, **kw):
+    from distributed_optimization_tpu.serving.client import RetryingClient
+
+    c = RetryingClient("http://127.0.0.1:1", max_retries=3,
+                       backoff_s=0.01, seed=0, sleep=sleeps_append, **kw)
+    c._once = lambda method, path, body, timeout: (status, payload)
+    return c
+
+
+def test_client_backs_off_longer_on_tenant_blame():
+    from distributed_optimization_tpu.serving.client import (
+        RetriesExhaustedError,
+    )
+
+    results = {}
+    for reason in ("tenant_cap", "quarantined", "global_cap"):
+        sleeps, rec = _sleep_recorder()
+        c = _client_with_canned(429, {"error": "queue_full",
+                                      "reason": reason}, rec)
+        with pytest.raises(RetriesExhaustedError):
+            c.request("POST", "/v1/submit", {})
+        results[reason] = sleeps
+    # Same seed → identical jitter stream → the blame factor is exact.
+    for blamed in ("tenant_cap", "quarantined"):
+        assert all(
+            b == pytest.approx(4.0 * g)
+            for b, g in zip(results[blamed], results["global_cap"])
+        ), (blamed, results)
+    assert len(results["tenant_cap"]) == 3  # all retries still attempted
+
+
+def test_client_blame_factor_validation():
+    from distributed_optimization_tpu.serving.client import RetryingClient
+
+    with pytest.raises(ValueError, match="blame_backoff_factor"):
+        RetryingClient("http://x", blame_backoff_factor=0.5)
+
+
+def test_client_stops_retrying_confirmed_drain():
+    from distributed_optimization_tpu.serving.client import (
+        RetriesExhaustedError,
+        RetryingClient,
+    )
+
+    sleeps, rec = _sleep_recorder()
+    c = RetryingClient("http://127.0.0.1:1", max_retries=5,
+                       backoff_s=0.01, seed=0, sleep=rec)
+
+    def once(method, path, body, timeout):
+        if path == "/v1/status":
+            return 200, {"status": "serving", "draining": True}
+        return 503, {"error": "draining", "detail": "shutting down"}
+
+    c._once = once
+    with pytest.raises(RetriesExhaustedError, match="draining"):
+        c.request("POST", "/v1/submit", {})
+    assert c.n_retries == 0  # stopped IMMEDIATELY, no backoff burned
+    assert sleeps == []
+
+
+def test_client_keeps_retrying_unconfirmed_503():
+    """A 503 the status endpoint does NOT corroborate (e.g. a proxy
+    blip, or a daemon already restarting) stays retryable."""
+    from distributed_optimization_tpu.serving.client import (
+        RetriesExhaustedError,
+        RetryingClient,
+    )
+
+    sleeps, rec = _sleep_recorder()
+    c = RetryingClient("http://127.0.0.1:1", max_retries=2,
+                       backoff_s=0.01, seed=0, sleep=rec)
+
+    def once(method, path, body, timeout):
+        if path == "/v1/status":
+            return 200, {"status": "serving", "draining": False}
+        return 503, {"error": "draining", "detail": "shutting down"}
+
+    c._once = once
+    with pytest.raises(RetriesExhaustedError):
+        c.request("POST", "/v1/submit", {})
+    assert c.n_retries == 2  # full retry budget spent
+
+
+# ---------------------------------------- observatory remediation views
+
+
+def test_observatory_remediation_index_filters_and_compare(
+    tmp_path, capsys,
+):
+    """Satellite: ``observatory incidents`` flattens the remediation
+    block, ``--remediated/--unremediated`` split the ledger, and
+    ``compare`` surfaces the remediation-outcome delta."""
+    import json
+
+    from distributed_optimization_tpu.observability import observatory
+
+    log = tmp_path / "ops.incidents.jsonl"
+    eng = RemediationEngine(FleetOptions(incident_log=str(log)))
+    eng.on_worker_death(0, requeued=1, lost=0)  # remediated
+    eng.disable(POLICY_STORE)
+    # A bundle WITHOUT a remediation block (pre-fleet reader parity).
+    from distributed_optimization_tpu.observability.monitors import (
+        Anomaly,
+        build_incident,
+        write_incidents,
+    )
+
+    plain = build_incident(
+        _cfg(), Anomaly("divergence", "fatal", 40, "gap blew up", {}),
+        label="no-fleet",
+    )
+    write_incidents(log, [plain], append=True)
+
+    recs = observatory.build_incident_index(tmp_path)
+    assert len(recs) == 2
+    by_label = {r.label: r for r in recs}
+    assert by_label["fleet"].remediation_policy == POLICY_WORKER
+    assert by_label["fleet"].remediation_outcome == OUTCOME_REMEDIATED
+    assert by_label["no-fleet"].remediation_outcome is None
+
+    assert observatory.main(
+        ["incidents", str(tmp_path), "--remediated", "--json"]
+    ) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["label"] for r in rows] == ["fleet"]
+    assert observatory.main(
+        ["incidents", str(tmp_path), "--unremediated", "--json"]
+    ) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["label"] for r in rows] == ["no-fleet"]
+
+    # compare: the same incident class, fleet off (A) vs fleet on (B).
+    remediated = build_incident(
+        _cfg(), Anomaly("divergence", "fatal", 40, "gap blew up", {}),
+        label="with-fleet",
+        remediation={"policy": POLICY_DIVERGENCE,
+                     "outcome": OUTCOME_REMEDIATED},
+    )
+    diff = observatory.compare_manifests(plain, remediated)
+    rem = diff["incidents"]["remediation"]
+    assert rem["a"] == []
+    assert rem["b"] == [OUTCOME_REMEDIATED]
+    assert rem["delta_remediated"] == 1
+
+
+# ------------------------------------------------- worker pool scaling
+
+
+@pytest.mark.slow
+def test_worker_pool_scale_up_down_fresh_ids():
+    """Pool scaling mechanics with REAL processes: scale_up spawns fresh
+    worker ids (never reused), scale_down retires drain-aware, and the
+    floor holds."""
+    from distributed_optimization_tpu.serving.workers import WorkerPool
+
+    pool = WorkerPool(1)
+    pool.start()
+    try:
+        assert pool.worker_ids() == [0]
+        assert pool.scale_up(1) == [1]
+        assert pool.n_workers == 2
+        deadline = time.time() + 60.0
+        while pool.alive_count() < 2 and time.time() < deadline:
+            time.sleep(0.1)
+        assert pool.alive_count() == 2
+        with pytest.raises(ValueError, match="floor"):
+            pool.scale_down(2)
+        pool.scale_down(1)
+        deadline = time.time() + 60.0
+        while pool.stats()["retired"] < 1 and time.time() < deadline:
+            time.sleep(0.1)
+        st = pool.stats()
+        assert st["retired"] == 1
+        assert st["workers"] == 1
+        assert st["alive"] == 1
+        # Fresh id on the next scale-up: retired ids are never reused.
+        assert pool.scale_up(1) == [2]
+    finally:
+        pool.close()
+
+
+# ------------------------------------------------ chaos modes (slow)
+
+
+@pytest.mark.slow
+def test_chaos_fleet_divergence():
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_fleet_divergence,
+    )
+
+    record = chaos_fleet_divergence()
+    assert record.passed, record.detail
+
+
+@pytest.mark.slow
+def test_chaos_fleet_store_corruption(tmp_path):
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_fleet_store_corruption,
+    )
+
+    record = chaos_fleet_store_corruption(store_root=str(tmp_path))
+    assert record.passed, record.detail
+
+
+@pytest.mark.slow
+def test_chaos_fleet_worker_storm():
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_fleet_worker_storm,
+    )
+
+    record = chaos_fleet_worker_storm()
+    assert record.passed, record.detail
+
+
+@pytest.mark.slow
+def test_chaos_fleet_autoscale_cycle():
+    from distributed_optimization_tpu.scenarios.chaos import (
+        chaos_fleet_autoscale,
+    )
+
+    record = chaos_fleet_autoscale()
+    assert record.passed, record.detail

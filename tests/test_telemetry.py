@@ -357,6 +357,10 @@ PERF_ARTIFACT_KEYS = {
         "coarse_cadence_hoisted_vs_inline", "device",
         "eval_dominated_demo_three_forms", "protocol"},
     "faults.json": {"config", "device", "note", "runs"},
+    "fleet.json": {
+        "autoscale", "device", "divergence", "fleet_status", "gates",
+        "incidents", "latency", "note", "platform", "protocol", "store",
+        "stuck_requests", "traffic", "worker_kill"},
     "federated.json": {
         "device", "platform", "protocol", "note", "local_steps",
         "participation", "scale", "gates"},
