@@ -24,10 +24,34 @@ evaluated at ``x_read[i]`` while the averaging acts on the LIVE rows —
 the gap between the two is exactly the realized staleness the timeline
 records per event (surfaced as a histogram in ``health_summary``).
 
+Fault processes on the event clock (ISSUE-17): when the config's
+round-indexed fault knobs are active, the SAME chains
+``timeline_for_config`` builds for the synchronous paths are realized on
+the event axis by ``parallel.events.realize_event_faults`` — a crashed
+worker's event fires as a NO-OP (the in-flight gradient is lost, the
+pairing partner degrades to a self-loop), a sampled-out worker's events
+are thinned at the matched per-round rate, dead edges degrade the
+exchange, and recovery re-enters under the PR 3 rejoin policies
+(``frozen`` resumes the pre-crash row; ``neighbor_restart`` warm-starts
+from the realized alive neighborhood average). At constant latency the
+event realization collapses BITWISE onto the round-clock realization
+(tests pin it), and with every knob off the fault arrays are never
+threaded at all — the compiled program is literally the healthy one.
+
+Gradient tracking per event (DIGing, Nedić/Olshevsky/Shi '17): the carry
+gains tracker rows ``y`` and last-reported gradients ``g_prev``; an
+event's initiator refreshes its tracker by telescoping its new stale-read
+gradient against the previous one (``y_i ← avg_y + g(x_read_i) −
+g_prev_i``) so the network mean of ``y`` equals the mean of ``g_prev``
+EXACTLY at every event, at any staleness and under any fault composition
+(the tracking invariant the tests pin; the bench records how far the
+tracked mean drifts from the LIVE mean gradient as staleness grows).
+
 Resume-exactness: the timeline is rebuilt identically from the config,
-batch draws are counter-based in (seed, worker, local_step), and the
-carry is just ``{x, x_read}`` — so a run split at any eval boundary via
-``state0``/``start_event`` replays the identical tail events bitwise
+batch draws are counter-based in (seed, worker, local_step[, local
+descent]), and the carry is just the algorithm state — so a run split at
+any eval boundary via ``state0``/``start_event`` (or an event-indexed
+``RunCheckpointer`` chunk) replays the identical tail events bitwise
 (tests/test_async.py pins it through a save/restore round-trip on both
 backends).
 """
@@ -59,13 +83,16 @@ from distributed_optimization_tpu.utils.data import HostDataset, stack_shards
 # fold_in(fold_in(fold_in(key(seed), TAG), worker), local_step) — a
 # distinct stream from every synchronous sampler, counter-based in the
 # worker's OWN step count so a draw never depends on the interleaving.
+# With local_steps τ > 1 the m-th local descent (m = 0..τ−1) folds m in
+# once more; τ = 1 keeps the original unfolded key so the healthy program
+# is bitwise the PR 9 one.
 _ASYNC_BATCH_TAG = 0xA57E
 
 
 @functools.lru_cache(maxsize=8)
 def _cached_timeline(
     topology, n, er_p, topo_seed, horizon, seed, latency_model,
-    latency_mean, latency_tail,
+    latency_mean, latency_tail, gossip_schedule,
 ):
     topo = build_topology(
         topology, n, erdos_renyi_p=er_p, seed=topo_seed,
@@ -73,7 +100,7 @@ def _cached_timeline(
     return topo, build_event_timeline(
         topo, horizon, seed,
         latency_model=latency_model, latency_mean=latency_mean,
-        latency_tail=latency_tail,
+        latency_tail=latency_tail, gossip_schedule=gossip_schedule,
     )
 
 
@@ -88,7 +115,46 @@ def timeline_for(config):
         config.topology, config.n_workers, config.erdos_renyi_p,
         config.resolved_topology_seed(), config.n_iterations, config.seed,
         config.latency_model, config.latency_mean, config.latency_tail,
+        config.gossip_schedule,
     )
+
+
+def event_faults_for(config, topo, timeline, fault_timeline=None):
+    """Realize the config's fault chains on the event axis.
+
+    Returns ``(fault_timeline, realization, restart_rows)`` —
+    ``(None, None, None)`` when no fault knob is active, so the healthy
+    path never threads fault arrays at all (the crash-free bitwise
+    contract is structural, not numeric). ``fault_timeline`` overrides
+    the config-derived chains (the equivalence tests inject hand-built
+    masks); ``restart_rows`` is the ``[E, N]`` warm-restart weight table,
+    present only under ``rejoin='neighbor_restart'`` with realized rejoin
+    events.
+    """
+    from distributed_optimization_tpu.parallel.events import (
+        realize_event_faults,
+        rejoin_restart_rows,
+    )
+    from distributed_optimization_tpu.parallel.faults import (
+        config_faults_active,
+        timeline_for_config,
+    )
+
+    if fault_timeline is None:
+        if not config_faults_active(config):
+            return None, None, None
+        fault_timeline = timeline_for_config(
+            config, topo, timeline.n_rounds
+        )
+    realization = realize_event_faults(timeline, fault_timeline)
+    restart = None
+    if config.rejoin == "neighbor_restart" and bool(
+        realization.rejoin.any()
+    ):
+        restart = rejoin_restart_rows(
+            timeline, fault_timeline, realization, topo
+        )
+    return fault_timeline, realization, restart
 
 
 def _validate_slice(config, E: int, start_event: int, n_events: Optional[int]):
@@ -191,6 +257,8 @@ def run_async(
     progress_cb=None,
     progress_every: int = 1,
     monitors=None,
+    checkpoint=None,
+    _fault_timeline=None,
 ) -> BackendRunResult:
     """Run one asynchronous experiment (``config.execution == 'async'``).
 
@@ -211,16 +279,27 @@ def run_async(
     ``halt_on='fatal'`` the run stops at the next segment boundary with
     the executed prefix as a partial result.
 
-    ``batch_schedule [E_total, b]`` injects fixed per-EVENT batch indices
-    into the firing worker's shard (the oracle-equivalence convention —
-    the async twin of the synchronous ``[T, N, b]`` schedule).
-    ``state0``/``start_event``/``n_events`` continue a previous slice from
-    its ``final_state`` ({x, x_read} leaves): the schedule and the
-    counter-based batch draws are functions of the config alone, so the
-    continuation is exactly the one-shot program split in two (bitwise —
-    the resume-exactness contract). ``executable_cache`` follows the
+    ``checkpoint`` (ISSUE-17): a ``utils.checkpoint.CheckpointOptions``;
+    the run then executes through the segmented machinery saving one
+    event-indexed ``RunCheckpointer`` chunk every ``every_evals`` eval
+    boundaries (chunk cursor = eval rows done = ``eval_every * N`` events
+    each), and ``resume=True`` restores the latest intact chunk (the PR 3
+    truncated-chunk fallback) and replays the tail bitwise — the
+    schedule, fault realization, and counter-based batch draws all
+    rebuild from the config alone.
+
+    ``batch_schedule`` injects fixed per-EVENT batch indices into the
+    firing worker's shard (the oracle-equivalence convention — the async
+    twin of the synchronous ``[T, N, b]`` schedule): ``[E_total, b]``
+    rows, or ``[E_total, τ, b]`` when ``local_steps=τ > 1`` (one row per
+    local descent). ``state0``/``start_event``/``n_events`` continue a
+    previous slice from its ``final_state`` leaves: the continuation is
+    exactly the one-shot program split in two (bitwise — the
+    resume-exactness contract). ``executable_cache`` follows the
     sequential path's convention (docs/SERVING.md); the window facts are
-    part of the key.
+    part of the key. ``_fault_timeline`` injects a hand-built
+    ``FaultTimeline`` in place of the config-derived chains
+    (equivalence tests only; disables the executable cache).
     """
     from distributed_optimization_tpu.backends.base import x64_scope
 
@@ -232,7 +311,8 @@ def run_async(
             state0=state0, start_event=start_event, n_events=n_events,
             executable_cache=executable_cache,
             progress_cb=progress_cb, progress_every=progress_every,
-            monitors=monitors,
+            monitors=monitors, checkpoint=checkpoint,
+            _fault_timeline=_fault_timeline,
         )
 
 
@@ -252,11 +332,26 @@ def _run_async(
     progress_cb=None,
     progress_every: int = 1,
     monitors=None,
+    checkpoint=None,
+    _fault_timeline=None,
 ) -> BackendRunResult:
     if progress_every < 1:
         raise ValueError(
             f"progress_every must be >= 1 eval-chunks, got {progress_every}"
         )
+    if checkpoint is not None:
+        if config.telemetry:
+            raise ValueError(
+                "telemetry trace buffers are not checkpointed: a resumed "
+                "run would report a hole — run telemetry without "
+                "checkpointing, or checkpoint without telemetry"
+            )
+        if state0 is not None or start_event != 0:
+            raise ValueError(
+                "checkpointed async runs manage their own continuation "
+                "cursor (the RunCheckpointer chunk); don't combine "
+                "checkpoint= with state0/start_event"
+            )
     problem = get_problem(
         config.problem_type, huber_delta=config.huber_delta,
         n_classes=config.n_classes,
@@ -273,52 +368,96 @@ def _run_async(
         config, E, start_event, n_events
     )
     n_evals = n_events // events_per_eval
-    rounds_slice = n_events // n
     start_round = start_event // n
 
+    algo_gt = config.algorithm == "gradient_tracking"
+    tau = int(config.local_steps)
+    telemetry_on = bool(config.telemetry)
+
+    # Event-axis fault realization (None triple when every knob is off —
+    # the healthy program then never sees a fault array: the crash-free
+    # bitwise gate is structural).
+    _, fault_real, restart_rows = event_faults_for(
+        config, topo, timeline, _fault_timeline
+    )
+    faults_on = fault_real is not None
+    restart_on = restart_rows is not None
+
     sl = slice(start_event, start_event + n_events)
+    partner_src = fault_real.partner if faults_on else timeline.partner
     ev_chunks = {
         "worker": jnp.asarray(
             timeline.worker[sl].reshape(n_evals, events_per_eval)
         ),
         "partner": jnp.asarray(
-            timeline.partner[sl].reshape(n_evals, events_per_eval)
+            partner_src[sl].reshape(n_evals, events_per_eval)
         ),
         "local_step": jnp.asarray(
             timeline.local_step[sl].reshape(n_evals, events_per_eval)
         ),
     }
+    if faults_on:
+        ev_chunks["fire"] = jnp.asarray(
+            fault_real.fire[sl].reshape(n_evals, events_per_eval)
+        )
+    if restart_on:
+        ev_chunks["rejoin"] = jnp.asarray(
+            fault_real.rejoin[sl].reshape(n_evals, events_per_eval)
+        )
+        ev_chunks["restart_w"] = jnp.asarray(
+            restart_rows[sl].reshape(n_evals, events_per_eval, n),
+            dtype=dtype,
+        )
     sched_sig = None
     if batch_schedule is not None:
         batch_schedule = np.asarray(batch_schedule)
         if batch_schedule.shape[0] != E:
             raise ValueError(
                 f"async batch_schedule carries {batch_schedule.shape[0]} "
-                f"event rows; the schedule has {E} events (one [b] index "
+                f"event rows; the schedule has {E} events (one index "
                 "row per event into the firing worker's shard)"
+            )
+        if tau == 1:
+            if batch_schedule.ndim != 2:
+                raise ValueError(
+                    f"async batch_schedule must be [E, b] at local_steps="
+                    f"1; got shape {batch_schedule.shape}"
+                )
+        elif batch_schedule.ndim != 3 or batch_schedule.shape[1] != tau:
+            raise ValueError(
+                f"async batch_schedule must be [E, {tau}, b] at "
+                f"local_steps={tau} (one [b] row per local descent); got "
+                f"shape {batch_schedule.shape}"
             )
         ev_chunks["schedule"] = jnp.asarray(
             batch_schedule[sl].reshape(
-                n_evals, events_per_eval, batch_schedule.shape[1]
+                (n_evals, events_per_eval) + batch_schedule.shape[1:]
             ),
             dtype=jnp.int32,
         )
         sched_sig = tuple(batch_schedule.shape)
 
     # --- initial carry ------------------------------------------------
+    # The algorithm leaves are the resume-contract surface; the telemetry
+    # scratch row g_norm (last fired gradient norm per worker) is carried
+    # too but excluded from state0/final_state — it feeds the trace
+    # buffers only and never touches the optimization dataflow.
     x0 = jnp.zeros((n, d_model), dtype=dtype)
+    carry_leaves = ("x", "x_read") + (
+        ("y", "g_prev") if algo_gt else ()
+    )
     if state0 is None:
         if start_event != 0:
             raise ValueError(
                 "continuing from start_event > 0 needs the previous "
-                "slice's final_state ({x, x_read}) as state0"
+                f"slice's final_state ({list(carry_leaves)}) as state0"
             )
-        st0 = {"x": x0, "x_read": x0}
+        st0 = {k: x0 for k in carry_leaves}
     else:
-        if set(state0) != {"x", "x_read"}:
+        if set(state0) != set(carry_leaves):
             raise ValueError(
                 f"async state0 leaves {sorted(state0)} do not match the "
-                "event-path carry ['x', 'x_read']"
+                f"event-path carry {list(carry_leaves)}"
             )
         st0 = {
             k: jnp.asarray(v).astype(dtype) for k, v in state0.items()
@@ -329,6 +468,9 @@ def _run_async(
                     f"state0[{k!r}] has shape {v.shape}; expected "
                     f"{(n, d_model)}"
                 )
+    if telemetry_on:
+        st0 = dict(st0)
+        st0["g_norm"] = jnp.zeros((n,), dtype=dtype)
 
     from distributed_optimization_tpu.backends.jax_backend import (
         _make_eta_fn,
@@ -351,13 +493,17 @@ def _run_async(
     }
 
     def make_chunk_body(data):
-        X, y, n_valid = data["X"], data["y"], data["n_valid"]
+        X, y_data, n_valid = data["X"], data["y"], data["n_valid"]
 
-        def event_grad(x_read_i, ev):
+        def event_grad(x_at, ev, m):
+            """Stale-read minibatch gradient for the m-th local descent
+            (m is a Python int; None ≡ the τ=1 single descent, which
+            keeps the original PR 9 key so the healthy program is
+            bitwise unchanged)."""
             i, k = ev["worker"], ev["local_step"]
-            Xi, yi, ni = X[i], y[i], n_valid[i]
+            Xi, yi, ni = X[i], y_data[i], n_valid[i]
             if "schedule" in ev:
-                idx = ev["schedule"]
+                idx = ev["schedule"] if m is None else ev["schedule"][m]
                 Xb, yb = Xi[idx], yi[idx]
                 wts = jnp.full(
                     idx.shape, 1.0 / idx.shape[0], dtype=dtype
@@ -368,30 +514,107 @@ def _run_async(
                 Xb, yb = Xi, yi
             else:
                 wkey = jax.random.fold_in(jax.random.fold_in(key, i), k)
+                if m is not None:
+                    wkey = jax.random.fold_in(wkey, m)
                 idx, w = sample_batch_indices(wkey, L, ni, batch_size)
                 Xb, yb = Xi[idx], yi[idx]
                 wts = w.astype(dtype)
-            return problem.gradient_weighted(x_read_i, Xb, yb, wts, reg)
+            return problem.gradient_weighted(x_at, Xb, yb, wts, reg)
+
+        def local_chain(x_start, corr, eta, ev):
+            """τ local descents fused into one event (Koloskova '20's
+            local-update axis on the event clock): z_{m+1} = z_m −
+            η(corr + g(z_m)); returns (z_τ − z_0, mean gradient)."""
+            z = x_start
+            gsum = jnp.zeros_like(x_start)
+            for m in range(tau):
+                gm = event_grad(z, ev, m)
+                gsum = gsum + gm
+                z = (z - eta * (corr + gm)).astype(dtype)
+            g_mean = (gsum / tau).astype(dtype)
+            return (z - x_start).astype(dtype), g_mean
 
         def event_step(carry, ev):
             x, x_read = carry["x"], carry["x_read"]
             i, j = ev["worker"], ev["partner"]
-            g = event_grad(x_read[i], ev)
             eta = eta_fn(ev["local_step"]).astype(dtype)
-            xi, xj = x[i], x[j]
+            xi, read_i = x[i], x_read[i]
+            if restart_on:
+                # neighbor_restart rejoin: the re-entering worker warm-
+                # starts from its realized alive neighborhood's average
+                # (the precomputed weight row; x only — the GT tracker
+                # rows are untouched, preserving the tracking invariant).
+                warm = (ev["restart_w"] @ x).astype(dtype)
+                rj = ev["rejoin"]
+                xi = jnp.where(rj, warm, xi)
+                read_i = jnp.where(rj, warm, read_i)
+            xj = x[j]
             matched = j != i
             avg = (0.5 * (xi + xj)).astype(dtype)
+            base_i = jnp.where(matched, avg, xi)
             # D-PSGD ordering (Lian et al. '17 Alg. 1): average the live
             # rows, then worker i descends along its (stale) gradient;
             # the passive partner only averages. Writing j before i keeps
-            # the solo case (j == i, isolated node) a plain local step.
-            new_i = (jnp.where(matched, avg, xi) - eta * g).astype(dtype)
+            # the solo case (j == i: isolated, or degraded by a dead
+            # partner/edge) a plain local step.
+            if algo_gt:
+                y, g_prev = carry["y"], carry["g_prev"]
+                yi, yj, gpi = y[i], y[j], g_prev[i]
+                avg_y = (0.5 * (yi + yj)).astype(dtype)
+                base_y = jnp.where(matched, avg_y, yi)
+                if tau == 1:
+                    g_ev = event_grad(read_i, ev, None)
+                    new_y_i = (base_y + g_ev - gpi).astype(dtype)
+                    new_i = (base_i - eta * new_y_i).astype(dtype)
+                else:
+                    corr = (base_y - gpi).astype(dtype)
+                    delta, g_ev = local_chain(read_i, corr, eta, ev)
+                    new_y_i = (base_y + g_ev - gpi).astype(dtype)
+                    new_i = (base_i + delta).astype(dtype)
+                new_y_j = jnp.where(matched, avg_y, yj)
+            else:
+                if tau == 1:
+                    g_ev = event_grad(read_i, ev, None)
+                    new_i = (base_i - eta * g_ev).astype(dtype)
+                else:
+                    delta, g_ev = local_chain(
+                        read_i, jnp.zeros((), dtype=dtype), eta, ev
+                    )
+                    new_i = (base_i + delta).astype(dtype)
             new_j = jnp.where(matched, avg, xj)
+            if faults_on:
+                # A non-firing event is a total no-op: the crashed (or
+                # sampled-out) worker's in-flight gradient is lost and
+                # nobody's row moves.
+                fire = ev["fire"]
+                new_i = jnp.where(fire, new_i, x[i])
+                new_j = jnp.where(fire, new_j, x[j])
+                new_read = jnp.where(fire, new_i, x_read[i])
+            else:
+                new_read = new_i
             x = x.at[j].set(new_j)
             x = x.at[i].set(new_i)
             # Worker i immediately re-reads and starts its next gradient.
-            x_read = x_read.at[i].set(new_i)
-            return {"x": x, "x_read": x_read}, None
+            x_read = x_read.at[i].set(new_read)
+            out = {"x": x, "x_read": x_read}
+            if algo_gt:
+                if faults_on:
+                    new_y_i = jnp.where(fire, new_y_i, y[i])
+                    new_y_j = jnp.where(fire, new_y_j, y[j])
+                    new_gp = jnp.where(fire, g_ev, gpi)
+                else:
+                    new_gp = g_ev
+                y = y.at[j].set(new_y_j)
+                y = y.at[i].set(new_y_i)
+                out["y"] = y
+                out["g_prev"] = g_prev.at[i].set(new_gp)
+            if telemetry_on:
+                gn = carry["g_norm"]
+                g_n = jnp.sqrt(jnp.sum(g_ev * g_ev)).astype(dtype)
+                if faults_on:
+                    g_n = jnp.where(fire, g_n, gn[i])
+                out["g_norm"] = gn.at[i].set(g_n)
+            return out, None
 
         def chunk_body(carry, ev_row):
             carry, _ = jax.lax.scan(event_step, carry, ev_row)
@@ -399,11 +622,18 @@ def _run_async(
             if collect_metrics:
                 x = carry["x"]
                 xbar = jnp.mean(x, axis=0)
-                out["gap"] = full_objective(xbar, X, y, n_valid) - f_opt
+                out["gap"] = full_objective(xbar, X, y_data, n_valid) - f_opt
                 if track_consensus:
                     out["cons"] = jnp.mean(
                         jnp.sum((x - xbar[None, :]) ** 2, axis=1)
                     )
+            if telemetry_on:
+                x = carry["x"]
+                out["param_norm"] = jnp.sqrt(jnp.sum(x * x, axis=1))
+                out["grad_norm"] = carry["g_norm"]
+                out["nonfinite"] = jnp.sum(
+                    ~jnp.isfinite(x), dtype=jnp.int32
+                )
             return carry, out
 
         return chunk_body
@@ -411,35 +641,132 @@ def _run_async(
     def run_scan(state, data):
         return jax.lax.scan(make_chunk_body(data), state, data["ev"])
 
-    exec_cache = resolve_cache(executable_cache)
+    # An injected fault timeline bypasses the config, which is the whole
+    # executable-cache key — never cache those programs.
+    exec_cache = (
+        resolve_cache(executable_cache) if _fault_timeline is None else None
+    )
+
+    # Comms accounting rows (host precompute): only FIRED live exchanges
+    # move data — both models cross the wire (2·d floats), and gradient
+    # tracking ships the tracker row alongside (4·d). Solo, degraded,
+    # and non-firing events move nothing.
+    matched_eff = (
+        fault_real.matched_fired if faults_on else timeline.matched()
+    )
+    per_exchange = (4.0 if algo_gt else 2.0) * float(d_model)
+    floats_rows = per_exchange * matched_eff[sl].reshape(
+        n_evals, events_per_eval
+    ).sum(axis=1).astype(np.float64)
+
+    tele_rows: dict[str, list] = {
+        "param_norm": [], "grad_norm": [], "nonfinite": [],
+    }
+
+    def _collect_tele(outs, rows):
+        if telemetry_on and rows:
+            tele_rows["param_norm"].extend(
+                np.asarray(outs["param_norm"], dtype=np.float32)[:rows]
+            )
+            tele_rows["grad_norm"].extend(
+                np.asarray(outs["grad_norm"], dtype=np.float32)[:rows]
+            )
+            tele_rows["nonfinite"].extend(
+                np.asarray(outs["nonfinite"], dtype=np.float32)[:rows]
+            )
+
     n_done_evals = n_evals
-    if progress_cb is not None or monitors is not None:
-        # Progress streaming (ISSUE-10; segment-fused in ISSUE-13): the
-        # run executes as SEGMENTS of ``progress_every`` eval chunks,
-        # each segment one compiled call of the SAME outer scan over its
-        # chunk rows — the event arrays are traced inputs, so one
-        # executable serves every same-size segment, and the per-segment
-        # scans compose to exactly the fused program's computation
-        # (bitwise, asserted in tests/test_observatory.py /
-        # tests/test_monitors.py). The host syncs once per heartbeat
-        # instead of once per chunk — the ISSUE-10 per-chunk loop's
-        # measured 12.3% overhead was pure dispatch latency this buys
-        # back (docs/perf/observatory.json).
+    time_rows = None
+    start_chunk = 0
+    if progress_cb is not None or monitors is not None or checkpoint is not None:
+        # Progress streaming (ISSUE-10; segment-fused in ISSUE-13) and
+        # event-indexed checkpointing (ISSUE-17): the run executes as
+        # SEGMENTS of eval chunks, each segment one compiled call of the
+        # SAME outer scan over its chunk rows — the event arrays are
+        # traced inputs, so one executable serves every same-size
+        # segment, and the per-segment scans compose to exactly the
+        # fused program's computation (bitwise, asserted in
+        # tests/test_observatory.py / tests/test_monitors.py /
+        # tests/test_async_faults.py). The host syncs once per segment
+        # boundary instead of once per chunk.
         from distributed_optimization_tpu.backends.jax_backend import (
             _fanout_progress,
+            _fetch_to_host,
         )
 
-        cb = _fanout_progress(progress_cb, monitors)
-        emit = _async_progress_emitter(config, cb, timeline, start_event)
-        halt_check = (
-            monitors.should_halt
-            if monitors is not None and monitors.halt_on != "never"
-            else None
-        )
-        seg_chunks = min(max(int(progress_every), 1), n_evals)
-        sizes = {seg_chunks}
-        if n_evals % seg_chunks:
-            sizes.add(n_evals % seg_chunks)
+        emit = halt_check = None
+        if progress_cb is not None or monitors is not None:
+            cb = _fanout_progress(progress_cb, monitors)
+            emit = _async_progress_emitter(
+                config, cb, timeline, start_event
+            )
+            halt_check = (
+                monitors.should_halt
+                if monitors is not None and monitors.halt_on != "never"
+                else None
+            )
+
+        # Checkpoint cursor: one chunk = one eval row = eval_every * N
+        # events. Resume restores the latest intact chunk (truncated
+        # chunks fall back — the RunCheckpointer contract) and the loop
+        # below replays only the tail.
+        ckptr = None
+        gap_list: list[float] = []
+        cons_list: list[float] = []
+        time_list: list[float] = []
+        if checkpoint is not None:
+            from distributed_optimization_tpu.utils.checkpoint import (
+                RunCheckpointer,
+            )
+
+            ckptr = RunCheckpointer(checkpoint)
+            restored = None
+            # The event schedule is horizon-GLOBAL (events interleave
+            # across rounds by completion time), so extending
+            # n_iterations would replay a different event prefix than
+            # the saved chunks executed — pin it in the sidecar.
+            if checkpoint.resume:
+                ckptr.validate_or_record_config(
+                    config, resumable_keys=frozenset(),
+                )
+                restored = ckptr.restore()
+            else:
+                ckptr.reset(config, resumable_keys=frozenset())
+            if restored is not None:
+                state_np, gaps_r, conss_r, _fl, times_r, start_chunk = (
+                    restored
+                )
+                if start_chunk > n_evals:
+                    raise ValueError(
+                        f"checkpoint at chunk {start_chunk} exceeds this "
+                        f"run's horizon ({n_evals} eval chunks); raise "
+                        "n_iterations to extend the checkpointed progress"
+                    )
+                if set(state_np) != set(carry_leaves):
+                    raise ValueError(
+                        f"checkpointed state leaves {sorted(state_np)} do "
+                        f"not match the event-path carry "
+                        f"{list(carry_leaves)}"
+                    )
+                st0 = {
+                    k: jnp.asarray(v).astype(dtype)
+                    for k, v in state_np.items()
+                }
+                gap_list = [float(g) for g in gaps_r]
+                cons_list = [float(c) for c in conss_r]
+                time_list = [float(t) for t in times_r]
+
+        if checkpoint is not None:
+            seg_pref = checkpoint.every_evals
+            if progress_cb is not None or monitors is not None:
+                seg_pref = min(seg_pref, max(int(progress_every), 1))
+        else:
+            seg_pref = max(int(progress_every), 1)
+        remaining = n_evals - start_chunk
+        seg_chunks = min(seg_pref, max(remaining, 1))
+        sizes = {seg_chunks} if remaining else set()
+        if remaining % seg_chunks:
+            sizes.add(remaining % seg_chunks)
 
         def seg_scan(state, data):
             return jax.lax.scan(make_chunk_body(data), state, data["ev"])
@@ -478,9 +805,11 @@ def _run_async(
 
         t1 = time.perf_counter()
         state = st0
-        gap_list: list[float] = []
-        cons_list: list[float] = []
-        done = 0
+        save_seconds = 0.0
+        prev_elapsed = 0.0
+        t_base = time_list[-1] if time_list else 0.0
+        done = start_chunk
+        halted = False
         while done < n_evals:
             this_chunks = min(seg_chunks, n_evals - done)
             data_c = dict(data_args)
@@ -497,24 +826,48 @@ def _run_async(
                 cons_list.extend(
                     float(c) for c in np.asarray(outs["cons"])
                 )
+            _collect_tele(outs, this_chunks)
             done += this_chunks
-            emit(
-                done * events_per_eval,
-                start_round + done * config.eval_every,
-                gap_list[-1] if gap_list else None,
-                cons_list[-1] if cons_list else None,
-                time.perf_counter() - t1,
-                this_chunks * events_per_eval,
+            elapsed = time.perf_counter() - t1 - save_seconds
+            time_list.extend(
+                t_base + prev_elapsed
+                + (elapsed - prev_elapsed) * (r + 1) / this_chunks
+                for r in range(this_chunks)
             )
+            prev_elapsed = elapsed
+            if emit is not None:
+                emit(
+                    done * events_per_eval,
+                    start_round + done * config.eval_every,
+                    gap_list[-1] if gap_list else None,
+                    cons_list[-1] if cons_list else None,
+                    elapsed,
+                    this_chunks * events_per_eval,
+                )
             if halt_check is not None and halt_check():
                 # Early-halt policy (ISSUE-13): stop at this segment
                 # boundary; the executed event prefix is the fused
                 # program's prefix (the continuation contract).
+                halted = True
+            if ckptr is not None and (
+                done % checkpoint.every_evals == 0
+                or done == n_evals or halted
+            ):
+                # Save I/O excluded from the interpolated run stamps —
+                # it is checkpoint cost, not optimization time.
+                t_save = time.perf_counter()
+                ckptr.save(
+                    done, _fetch_to_host(state), gap_list, cons_list,
+                    floats_rows[:done], time_list,
+                )
+                save_seconds += time.perf_counter() - t_save
+            if halted:
                 break
         final_state = state
-        run_seconds = time.perf_counter() - t1
+        run_seconds = time.perf_counter() - t1 - save_seconds
         n_done_evals = done
-        if monitors is not None and done < n_evals:
+        time_rows = np.asarray(time_list, dtype=np.float64)
+        if monitors is not None and halted:
             monitors.note_halt(
                 start_round + done * config.eval_every
             )
@@ -569,20 +922,27 @@ def _run_async(
         cons_hist = (
             np.asarray(ys["cons"], dtype=np.float64) if "cons" in ys else None
         )
-    # Comms accounting: every matched event moves one pairwise exchange —
-    # both models cross the wire, 2·d floats (a solo event moves none).
+        _collect_tele(ys, n_evals)
     # Halted runs bill only the executed event prefix.
     done_events = n_done_evals * events_per_eval
     done_rounds = done_events // n
-    sl_done = slice(start_event, start_event + done_events)
-    matched_slice = int(np.sum(timeline.matched()[sl_done]))
-    total_floats = 2.0 * d_model * matched_slice
+    total_floats = float(floats_rows[:n_done_evals].sum())
+
+    trace = None
+    if telemetry_on:
+        trace = _async_trace(
+            config, timeline, fault_real, matched_eff, tele_rows,
+            start_event, n_done_evals, events_per_eval,
+        )
 
     history = RunHistory(
         objective=gap_hist,
         consensus_error=cons_hist,
-        time=np.linspace(
-            run_seconds / max(n_done_evals, 1), run_seconds, n_done_evals
+        time=(
+            time_rows if time_rows is not None else np.linspace(
+                run_seconds / max(n_done_evals, 1), run_seconds,
+                n_done_evals,
+            )
         ),
         time_measured=False,
         # Round-based iteration numbering (N events per round), so
@@ -594,11 +954,15 @@ def _run_async(
         ),
         total_floats_transmitted=total_floats,
         iters_per_second=(
-            done_rounds / run_seconds if run_seconds > 0 else float("nan")
+            (done_rounds - start_chunk * config.eval_every) / run_seconds
+            if run_seconds > 0 else float("nan")
         ),
         compile_seconds=compile_seconds,
         spectral_gap=topo.spectral_gap,
+        trace=trace,
     )
+    final_state = dict(final_state)
+    final_state.pop("g_norm", None)
     final_models = np.asarray(final_state["x"]).astype(np.float64)
     return BackendRunResult(
         history=history,
@@ -613,3 +977,44 @@ def _run_async(
             else None
         ),
     )
+
+
+def _async_trace(
+    config, timeline, fault_real, matched_eff, tele_rows, start_event,
+    n_rows, events_per_eval,
+):
+    """Flight-recorder buffers for the event path (``TRACE_FIELDS``
+    schema): the in-scan rows (param/grad norms, non-finite sentinel)
+    come from the scan outputs; the fault-layer rows are derived host-
+    side from the SAME realization the scan executed — ``nodes_up`` is
+    the per-worker event-fire fraction over each eval window (1.0 =
+    every event fired) and ``live_edges`` the mean per-round count of
+    live directed exchange endpoints."""
+    n = config.n_workers
+    sl = slice(start_event, start_event + n_rows * events_per_eval)
+    worker = timeline.worker[sl].reshape(n_rows, events_per_eval)
+    if fault_real is not None:
+        fire = fault_real.fire[sl].reshape(n_rows, events_per_eval)
+    else:
+        fire = np.ones((n_rows, events_per_eval), dtype=bool)
+    nodes_up = np.ones((n_rows, n), dtype=np.float32)
+    for r in range(n_rows):
+        fired = np.bincount(
+            worker[r], weights=fire[r].astype(np.float64), minlength=n
+        )
+        total = np.bincount(worker[r], minlength=n)
+        nodes_up[r] = np.where(
+            total > 0, fired / np.maximum(total, 1), 1.0
+        ).astype(np.float32)
+    live = matched_eff[sl].reshape(n_rows, events_per_eval).sum(axis=1)
+    live_edges = (
+        2.0 * live.astype(np.float64) / float(config.eval_every)
+    ).astype(np.float32)
+    return {
+        "param_norm": np.asarray(tele_rows["param_norm"], dtype=np.float32),
+        "grad_norm": np.asarray(tele_rows["grad_norm"], dtype=np.float32),
+        "nonfinite": np.asarray(tele_rows["nonfinite"], dtype=np.float32),
+        "nodes_up": nodes_up,
+        "live_edges": live_edges,
+        "clip_frac": np.zeros(n_rows, dtype=np.float32),
+    }

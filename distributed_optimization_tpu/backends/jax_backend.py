@@ -1254,13 +1254,6 @@ def run(
         # loudly rather than silently ignoring them.
         from distributed_optimization_tpu.backends import async_scan
 
-        if checkpoint is not None:
-            raise ValueError(
-                "execution='async' does not take the round-chunked "
-                "checkpoint machinery; continue a run exactly via "
-                "async_scan.run_async(state0=..., start_event=...) — the "
-                "event schedule and batch draws rebuild from the config"
-            )
         if measure_timestamps:
             raise ValueError(
                 "execution='async' reports the event schedule's simulated "
@@ -1279,7 +1272,7 @@ def run(
             measure_compile=measure_compile, return_state=return_state,
             executable_cache=executable_cache,
             progress_cb=progress_cb, progress_every=progress_every,
-            monitors=monitors,
+            monitors=monitors, checkpoint=checkpoint,
         )
     with x64_scope(config):
         return _run(

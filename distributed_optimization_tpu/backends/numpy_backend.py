@@ -77,23 +77,32 @@ def run_async(
     start_event: int = 0,
     n_events: Optional[int] = None,
     return_state: bool = False,
+    checkpoint=None,
+    _fault_timeline=None,
 ) -> BackendRunResult:
     """Per-event float64 twin of the jax scan-over-events path.
 
-    The event SCHEDULE comes from the shared host-side builder
-    (``parallel/events.py`` — the fault-timeline convention: both backends
-    agree on who fires when, with whom, and at what staleness), while the
-    per-event update math — pairwise average, stale-read gradient step,
-    the read-snapshot bookkeeping — is an independent float64
-    implementation written from the AD-PSGD recursion. Batch draws:
-    ``batch_schedule [E, b]`` injects per-event indices into the firing
-    worker's shard (the oracle-equivalence convention; standalone runs
-    draw from a host Generator, which the jax counter-based stream cannot
-    and need not reproduce). ``state0``/``start_event``/``n_events``
-    continue a previous slice exactly like the jax twin.
+    The event SCHEDULE and the event-axis fault realization come from the
+    shared host-side builders (``parallel/events.py`` — the
+    fault-timeline convention: both backends agree on who fires when,
+    with whom, at what staleness, and which events are lost to crashes or
+    thinning), while the per-event update math — pairwise average,
+    stale-read gradient step, the DIGing tracker telescoping, τ fused
+    local descents, rejoin warm restarts, the read-snapshot bookkeeping —
+    is an independent float64 implementation written from the published
+    recursions. Batch draws: ``batch_schedule`` injects per-event indices
+    into the firing worker's shard (``[E, b]``, or ``[E, τ, b]`` with
+    local_steps τ > 1 — the oracle-equivalence convention; standalone
+    runs draw from a host Generator, which the jax counter-based stream
+    cannot and need not reproduce). ``state0``/``start_event``/
+    ``n_events`` continue a previous slice exactly like the jax twin;
+    ``checkpoint`` runs the same event-indexed ``RunCheckpointer``
+    contract (one chunk per eval row, bitwise resume).
     """
     from distributed_optimization_tpu.backends.async_scan import (
+        _async_trace,
         _validate_slice,
+        event_faults_for,
         timeline_for,
     )
 
@@ -108,48 +117,91 @@ def run_async(
     n_events, events_per_eval = _validate_slice(
         config, E, start_event, n_events
     )
-    if batch_schedule is not None and len(batch_schedule) != E:
-        # Same contract (and message shape) as the jax twin: the schedule
-        # is indexed by ABSOLUTE event id, so a window-length schedule on
-        # a continued slice is the caller bug this catches.
-        raise ValueError(
-            f"async batch_schedule carries {len(batch_schedule)} event "
-            f"rows; the schedule has {E} events (one [b] index row per "
-            "event into the firing worker's shard)"
-        )
+    algo_gt = config.algorithm == "gradient_tracking"
+    tau = int(config.local_steps)
+    telemetry_on = bool(config.telemetry)
+    if checkpoint is not None:
+        if telemetry_on:
+            raise ValueError(
+                "telemetry trace buffers are not checkpointed: a resumed "
+                "run would report a hole — run telemetry without "
+                "checkpointing, or checkpoint without telemetry"
+            )
+        if state0 is not None or start_event != 0:
+            raise ValueError(
+                "checkpointed async runs manage their own continuation "
+                "cursor (the RunCheckpointer chunk); don't combine "
+                "checkpoint= with state0/start_event"
+            )
+    if batch_schedule is not None:
+        batch_schedule = np.asarray(batch_schedule)
+        if len(batch_schedule) != E:
+            # Same contract (and message shape) as the jax twin: the
+            # schedule is indexed by ABSOLUTE event id, so a
+            # window-length schedule on a continued slice is the caller
+            # bug this catches.
+            raise ValueError(
+                f"async batch_schedule carries {len(batch_schedule)} "
+                f"event rows; the schedule has {E} events (one index "
+                "row per event into the firing worker's shard)"
+            )
+        if tau == 1:
+            if batch_schedule.ndim != 2:
+                raise ValueError(
+                    f"async batch_schedule must be [E, b] at local_steps="
+                    f"1; got shape {batch_schedule.shape}"
+                )
+        elif batch_schedule.ndim != 3 or batch_schedule.shape[1] != tau:
+            raise ValueError(
+                f"async batch_schedule must be [E, {tau}, b] at "
+                f"local_steps={tau} (one [b] row per local descent); got "
+                f"shape {batch_schedule.shape}"
+            )
     n_evals = n_events // events_per_eval
     rounds_slice = n_events // n
     start_round = start_event // n
 
+    _, fault_real, restart_rows = event_faults_for(
+        config, topo, timeline, _fault_timeline
+    )
+    faults_on = fault_real is not None
+    restart_on = restart_rows is not None
+    partner_src = fault_real.partner if faults_on else timeline.partner
+
+    carry_leaves = ("x", "x_read") + (("y", "g_prev") if algo_gt else ())
     if state0 is None:
         if start_event != 0:
             raise ValueError(
                 "continuing from start_event > 0 needs the previous "
-                "slice's final_state ({x, x_read}) as state0"
+                f"slice's final_state ({list(carry_leaves)}) as state0"
             )
-        x = np.zeros((n, d))
-        x_read = np.zeros((n, d))
+        state = {k: np.zeros((n, d)) for k in carry_leaves}
     else:
-        if set(state0) != {"x", "x_read"}:
+        if set(state0) != set(carry_leaves):
             raise ValueError(
                 f"async state0 leaves {sorted(state0)} do not match the "
-                "event-path carry ['x', 'x_read']"
+                f"event-path carry {list(carry_leaves)}"
             )
-        x = np.array(state0["x"], dtype=np.float64, copy=True)
-        x_read = np.array(state0["x_read"], dtype=np.float64, copy=True)
+        state = {
+            k: np.array(v, dtype=np.float64, copy=True)
+            for k, v in state0.items()
+        }
 
     # Standalone batch draws are COUNTER-BASED in (seed, worker, local
-    # step) — one fresh Generator per event, like the jax twin's folded
-    # keys (independent stream, same contract): a draw never depends on
-    # the event interleaving or on how the run is split, which is what
-    # makes the continuation path bitwise without an injected schedule.
-    def event_batch(i: int, k: int) -> np.ndarray:
+    # step[, local descent]) — one fresh Generator per event, like the
+    # jax twin's folded keys (independent stream, same contract): a draw
+    # never depends on the event interleaving or on how the run is
+    # split, which is what makes the continuation path bitwise without
+    # an injected schedule. τ = 1 keeps the original 4-word counter so
+    # healthy runs replay the PR 9 stream exactly.
+    def event_batch(i: int, k: int, m: Optional[int]) -> np.ndarray:
         b = min(config.local_batch_size, shard_sizes[i])
         if b <= 0:
             return np.empty(0, dtype=np.int64)
-        erng = np.random.default_rng(
-            [config.seed & 0xFFFFFFFF, 0xA57E, i, k]
-        )
+        words = [config.seed & 0xFFFFFFFF, 0xA57E, i, k]
+        if m is not None:
+            words.append(m)
+        erng = np.random.default_rng(words)
         return erng.choice(shard_sizes[i], size=b, replace=False)
 
     eta0 = config.learning_rate_eta0
@@ -159,28 +211,146 @@ def run_async(
     cons_hist = np.full(n_evals, np.nan)
     time_hist = np.empty(n_evals)
 
+    # Event-indexed checkpointing (ISSUE-17): one chunk per eval row,
+    # shared RunCheckpointer contract with the jax twin (truncated-chunk
+    # fallback, config sidecar, bitwise resume — all RNG is
+    # counter-based, so the replayed tail is the uninterrupted run's).
+    ckptr = None
+    start_chunk = 0
+    if checkpoint is not None:
+        from distributed_optimization_tpu.utils.checkpoint import (
+            RunCheckpointer,
+        )
+
+        ckptr = RunCheckpointer(checkpoint)
+        restored = None
+        # Horizon-global event schedule: n_iterations is NOT resumable on
+        # the event clock (async_scan's sidecar convention).
+        if checkpoint.resume:
+            ckptr.validate_or_record_config(
+                config, resumable_keys=frozenset(),
+            )
+            restored = ckptr.restore()
+        else:
+            ckptr.reset(config, resumable_keys=frozenset())
+        if restored is not None:
+            state_np, gaps_r, conss_r, _fl, times_r, start_chunk = restored
+            if start_chunk > n_evals:
+                raise ValueError(
+                    f"checkpoint at chunk {start_chunk} exceeds this "
+                    f"run's horizon ({n_evals} eval chunks); raise "
+                    "n_iterations to extend the checkpointed progress"
+                )
+            if set(state_np) != set(carry_leaves):
+                raise ValueError(
+                    f"checkpointed state leaves {sorted(state_np)} do "
+                    f"not match the event-path carry {list(carry_leaves)}"
+                )
+            state = {
+                k: np.array(v, dtype=np.float64, copy=True)
+                for k, v in state_np.items()
+            }
+            gap_hist[:start_chunk] = np.asarray(gaps_r)[:start_chunk]
+            if len(conss_r):
+                cons_hist[:start_chunk] = np.asarray(conss_r)[:start_chunk]
+            time_hist[:start_chunk] = np.asarray(times_r)[:start_chunk]
+
+    x, x_read = state["x"], state["x_read"]
+    if algo_gt:
+        y, g_prev = state["y"], state["g_prev"]
+    g_norm = np.zeros(n) if telemetry_on else None
+    tele_rows: dict[str, list] = {
+        "param_norm": [], "grad_norm": [], "nonfinite": [],
+    }
+
+    def local_chain(x_start, corr, eta, e, i):
+        """τ local descents fused into one event (the jax twin's
+        ``local_chain``): z_{m+1} = z_m − η(corr + g(z_m))."""
+        Xi, yi = shards[i]
+        z = x_start.copy()
+        gsum = np.zeros_like(x_start)
+        k = int(timeline.local_step[e])
+        for m in range(tau):
+            if batch_schedule is not None:
+                idx = np.asarray(batch_schedule[e][m])
+            else:
+                idx = event_batch(i, k, m)
+            gm = gradient(z, Xi[idx], yi[idx], reg)
+            gsum += gm
+            z = z - eta * (corr + gm)
+        return z - x_start, gsum / tau
+
+    t_base = float(time_hist[start_chunk - 1]) if start_chunk else 0.0
+    save_seconds = 0.0
     start = time.perf_counter()
-    for off in range(n_events):
+    for off in range(start_chunk * events_per_eval, n_events):
         e = start_event + off
         i = int(timeline.worker[e])
-        j = int(timeline.partner[e])
-        k = int(timeline.local_step[e])
-        Xi, yi = shards[i]
-        if batch_schedule is not None:
-            idx = np.asarray(batch_schedule[e])
-        else:
-            idx = event_batch(i, k)
-        g = gradient(x_read[i], Xi[idx], yi[idx], reg)
-        eta = eta0 / np.sqrt(k + 1.0) if sqrt_decay else eta0
-        if j != i:
-            # D-PSGD ordering: average the live pair, then the firing
-            # worker descends along its stale-read gradient.
-            avg = 0.5 * (x[i] + x[j])
-            x[j] = avg
-            x[i] = avg - eta * g
-        else:  # solo event (isolated node): plain local step
-            x[i] = x[i] - eta * g
-        x_read[i] = x[i].copy()
+        # Mid-flight crash / thinned firing: the event is a no-op — but
+        # the eval-row bookkeeping below still runs (a window whose
+        # CLOSING event is a no-op must still emit its row).
+        fired = not (faults_on and not fault_real.fire[e])
+        if fired:
+            j = int(partner_src[e])
+            k = int(timeline.local_step[e])
+            eta = eta0 / np.sqrt(k + 1.0) if sqrt_decay else eta0
+            xi, read_i = x[i], x_read[i]
+            if restart_on and fault_real.rejoin[e]:
+                # neighbor_restart rejoin: warm-start from the realized
+                # alive neighborhood average (x only; GT tracker rows
+                # untouched).
+                warm = restart_rows[e] @ x
+                xi = warm
+                read_i = warm
+            matched = j != i
+            avg = 0.5 * (xi + x[j]) if matched else None
+            base_i = avg if matched else xi
+            if algo_gt:
+                # DIGing tracker telescoping at the stale read: the
+                # network sum of y tracks the sum of g_prev EXACTLY at
+                # every event.
+                avg_y = 0.5 * (y[i] + y[j]) if matched else None
+                base_y = avg_y if matched else y[i]
+                if tau == 1:
+                    Xi, yi_s = shards[i]
+                    if batch_schedule is not None:
+                        idx = np.asarray(batch_schedule[e])
+                    else:
+                        idx = event_batch(i, k, None)
+                    g = gradient(read_i, Xi[idx], yi_s[idx], reg)
+                    new_y_i = base_y + g - g_prev[i]
+                    new_i = base_i - eta * new_y_i
+                else:
+                    delta, g = local_chain(
+                        read_i, base_y - g_prev[i], eta, e, i
+                    )
+                    new_y_i = base_y + g - g_prev[i]
+                    new_i = base_i + delta
+                if matched:
+                    y[j] = avg_y
+                y[i] = new_y_i
+                g_prev[i] = g
+            else:
+                if tau == 1:
+                    Xi, yi_s = shards[i]
+                    if batch_schedule is not None:
+                        idx = np.asarray(batch_schedule[e])
+                    else:
+                        idx = event_batch(i, k, None)
+                    g = gradient(read_i, Xi[idx], yi_s[idx], reg)
+                    # D-PSGD ordering: average the live pair, then the
+                    # firing worker descends along its stale-read
+                    # gradient.
+                    new_i = base_i - eta * g
+                else:
+                    delta, g = local_chain(read_i, 0.0, eta, e, i)
+                    new_i = base_i + delta
+            if matched:
+                x[j] = avg
+            x[i] = new_i
+            x_read[i] = x[i].copy()
+            if telemetry_on:
+                g_norm[i] = float(np.linalg.norm(g))
         if (off + 1) % events_per_eval == 0:
             row = (off + 1) // events_per_eval - 1
             if collect_metrics:
@@ -191,12 +361,51 @@ def run_async(
                 )
                 if track_consensus:
                     cons_hist[row] = consensus_error(x)
-            time_hist[row] = time.perf_counter() - start
-    run_seconds = time.perf_counter() - start
+            if telemetry_on:
+                tele_rows["param_norm"].append(
+                    np.linalg.norm(x, axis=1).astype(np.float32)
+                )
+                tele_rows["grad_norm"].append(
+                    g_norm.astype(np.float32).copy()
+                )
+                tele_rows["nonfinite"].append(
+                    np.float32((~np.isfinite(x)).sum())
+                )
+            time_hist[row] = (
+                t_base + time.perf_counter() - start - save_seconds
+            )
+            if ckptr is not None and (
+                (row + 1) % checkpoint.every_evals == 0
+                or row + 1 == n_evals
+            ):
+                t_save = time.perf_counter()
+                ckptr.save(
+                    row + 1,
+                    {k: v.copy() for k, v in state.items()},
+                    gap_hist[:row + 1], cons_hist[:row + 1],
+                    (), time_hist[:row + 1],
+                )
+                save_seconds += time.perf_counter() - t_save
+    run_seconds = time.perf_counter() - start - save_seconds
 
-    matched_slice = int(
-        np.sum(timeline.matched()[start_event:start_event + n_events])
+    # Comms accounting: only FIRED live exchanges move data — 2·d floats
+    # for the model pair, 4·d for gradient tracking (tracker rows ride
+    # alongside). Solo, degraded, and non-firing events move nothing.
+    matched_eff = (
+        fault_real.matched_fired if faults_on else timeline.matched()
     )
+    matched_slice = int(
+        np.sum(matched_eff[start_event:start_event + n_events])
+    )
+    per_exchange = (4.0 if algo_gt else 2.0) * d
+
+    trace = None
+    if telemetry_on:
+        trace = _async_trace(
+            config, timeline, fault_real, matched_eff, tele_rows,
+            start_event, n_evals, events_per_eval,
+        )
+
     history = RunHistory(
         objective=gap_hist,
         consensus_error=cons_hist if track_consensus else None,
@@ -207,19 +416,19 @@ def run_async(
             start_round + rounds_slice + 1,
             config.eval_every,
         ),
-        # Every matched event is one pairwise exchange: 2·d floats.
-        total_floats_transmitted=2.0 * d * matched_slice,
+        total_floats_transmitted=per_exchange * matched_slice,
         iters_per_second=(
             rounds_slice / run_seconds if run_seconds > 0 else float("inf")
         ),
         spectral_gap=topo.spectral_gap,
+        trace=trace,
     )
     return BackendRunResult(
         history=history,
         final_models=x,
         final_avg_model=x.mean(axis=0),
         final_state=(
-            {"x": x, "x_read": x_read} if return_state else None
+            dict(state) if return_state else None
         ),
     )
 

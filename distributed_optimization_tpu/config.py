@@ -913,42 +913,32 @@ class ExperimentConfig:
                     "(its native kernel hard-codes the synchronous round); "
                     "use backend='jax' or the numpy oracle"
                 )
-            if self.algorithm != "dsgd":
+            if self.algorithm not in ("dsgd", "gradient_tracking"):
                 raise ValueError(
                     f"execution='async' is unsupported for "
                     f"{self.algorithm!r}: an event applies ONE worker's "
-                    "D-PSGD update (pairwise average + local step at its "
-                    "realized staleness) — gradient tracking's paired "
-                    "tracker exchange, EXTRA/ADMM's static-W fixed points, "
-                    "CHOCO's shared estimates and push-sum's mass pair "
-                    "have no per-event form — use algorithm='dsgd'"
+                    "update at its realized staleness — only dsgd's "
+                    "pairwise-average descent and gradient tracking's "
+                    "per-event tracker telescoping have an event form; "
+                    "EXTRA/ADMM's static-W fixed points, CHOCO's shared "
+                    "estimates and push-sum's mass pair do not — use "
+                    "algorithm='dsgd' or 'gradient_tracking'"
                 )
             if self.topology in DIRECTED_TOPOLOGIES:
                 raise ValueError(
                     "execution='async' realizes mutual pairwise exchanges; "
                     f"directed topology {self.topology!r} has one-way links"
                 )
-            if self.gossip_schedule != "synchronous":
-                raise ValueError(
-                    "execution='async' IS a gossip schedule (the event "
-                    "timeline's presampled pairings); gossip_schedule="
-                    f"{self.gossip_schedule!r} would impose a second one — "
-                    "leave gossip_schedule='synchronous'"
-                )
-            if (
-                self.edge_drop_prob > 0.0
-                or self.straggler_prob > 0.0
-                or self.mttf > 0.0
-                or self.participation_rate < 1.0
-            ):
-                raise ValueError(
-                    "execution='async' models stragglers as LATENCY in the "
-                    "event schedule (latency_model/latency_tail), not as "
-                    "drops; the round-indexed fault processes "
-                    "(edge_drop_prob/straggler_prob/mttf/participation_"
-                    "rate) have no event-schedule form yet — run fault "
-                    "studies on execution='sync'"
-                )
+            # gossip_schedule has an event-axis meaning (ISSUE-17):
+            # 'synchronous'/'one_peer' both name the timeline's sampled
+            # mutual matchings (the schedule IS one-peer per event) and
+            # 'round_robin' cycles the deterministic phase partners.
+            # Round-indexed fault knobs (edge_drop/straggler/mttf/
+            # participation) are realized on the event axis by
+            # parallel.events.realize_event_faults — a crashed worker's
+            # event fires as a no-op (mid-flight gradient lost), thinning
+            # skips events at the matched rate, and rejoin policies
+            # re-enter per docs/CHURN.md — so they compose here.
             if self.attack != "none" or (
                 self.aggregation != "gossip" and self.robust_b > 0
             ):
@@ -965,12 +955,6 @@ class ExperimentConfig:
                     "gossip: the error-feedback estimate exchange assumes "
                     "synchronized rounds, which the event schedule removes"
                 )
-            if self.local_steps > 1:
-                raise ValueError(
-                    "execution='async' already decouples gradient steps "
-                    "from exchanges per worker; local_steps > 1 is a "
-                    "round-based lever — use the latency model instead"
-                )
             if self.tp_degree > 1 or self.replicas > 1:
                 raise ValueError(
                     "execution='async' is a sequential scan over a totally "
@@ -984,14 +968,6 @@ class ExperimentConfig:
                     "representation topology (its regime is modest N with "
                     "long horizons, not the matrix-free 10k+ axis); use "
                     "topology_impl='dense' or 'auto'"
-                )
-            if self.telemetry:
-                raise ValueError(
-                    "execution='async' records no in-scan trace buffers "
-                    "(the staleness histogram and virtual-clock skew are "
-                    "derived from the presampled event timeline and appear "
-                    "in health_summary/RunTrace without telemetry) — set "
-                    "telemetry=False"
                 )
         if self.gossip_schedule not in ("synchronous", "one_peer",
                                         "round_robin"):

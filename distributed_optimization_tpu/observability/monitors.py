@@ -776,13 +776,72 @@ def fault_context(config, onset: int, *, window: Optional[int] = None,
             tl.staleness[ev_lo:ev_hi], dtype=np.float64
         )
         if stale.size:
-            context["async"] = {
+            block = {
                 "latency_model": config.latency_model,
                 "latency_tail": float(config.latency_tail),
+                # Event-axis coordinates of the onset (ISSUE-17): one
+                # round is N events, so the onset round's first event
+                # index anchors the incident on the clock the backend
+                # actually scanned.
+                "onset_event": int(onset * n),
+                "event_window": [int(ev_lo), int(min(ev_hi, len(tl.worker)))],
                 "window_staleness_p50": float(np.percentile(stale, 50)),
                 "window_staleness_p90": float(np.percentile(stale, 90)),
                 "window_staleness_max": float(stale.max()),
             }
+            if config_faults_active(config):
+                # Event-realized fault forensics: which firings in the
+                # onset window were in-flight losses (the stale gradient
+                # evaporated with the crash) and which workers were down
+                # at the onset round — host-rebuilt, bitwise the
+                # realization the backend executed.
+                from distributed_optimization_tpu.parallel import (
+                    build_topology,
+                )
+                from distributed_optimization_tpu.parallel.events import (
+                    realize_event_faults,
+                )
+                from distributed_optimization_tpu.parallel.faults import (
+                    timeline_for_config,
+                )
+
+                topo = build_topology(
+                    config.topology, config.n_workers,
+                    erdos_renyi_p=config.erdos_renyi_p,
+                    seed=config.resolved_topology_seed(),
+                )
+                ft = timeline_for_config(config, topo, tl.n_rounds)
+                real = realize_event_faults(tl, ft)
+                win_fire = real.fire[ev_lo:ev_hi]
+                kk = tl.local_step.astype(np.int64)[ev_lo:ev_hi]
+                win_worker = tl.worker[ev_lo:ev_hi].astype(np.int64)
+                # Crash no-ops only (the EventFaultRealization
+                # ``n_inflight_lost`` split): thinned events never had a
+                # gradient in flight.
+                win_up = (
+                    ft.node_up[kk, win_worker]
+                    if ft.node_up is not None
+                    else np.ones(len(win_worker), dtype=bool)
+                )
+                lost = win_worker[~win_up]
+                onset_row = min(onset, tl.n_rounds - 1)
+                up = np.ones(n, dtype=bool)
+                if ft.node_up is not None:
+                    up &= ft.node_up[onset_row]
+                if ft.part_up is not None:
+                    up &= ft.part_up[onset_row]
+                crashed = np.flatnonzero(~up)
+                block["n_inflight_lost_window"] = int((~win_up).sum())
+                block["inflight_lost_workers"] = sorted(
+                    set(lost.tolist())
+                )[:64]
+                block["crashed_workers_at_onset"] = (
+                    crashed.astype(int).tolist()[:64]
+                )
+                block["window_availability"] = (
+                    float(win_fire.mean()) if win_fire.size else 1.0
+                )
+            context["async"] = block
     return context
 
 

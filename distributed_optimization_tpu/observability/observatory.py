@@ -208,23 +208,34 @@ class IncidentRecord:
     # bundle predates the fleet or nothing acted on it.
     remediation_policy: Optional[str] = None
     remediation_outcome: Optional[str] = None
+    # Event-clock forensics (ISSUE-17; async fault context): the onset
+    # round's first event index and the onset window's in-flight gradient
+    # losses. None for synchronous or fault-free bundles.
+    onset_event: Optional[int] = None
+    n_inflight_lost: Optional[int] = None
 
     def row(self) -> str:
         onset = (
             str(self.onset_iteration)
             if self.onset_iteration is not None else "—"
         )
+        ev = str(self.onset_event) if self.onset_event is not None else "—"
+        lost = (
+            str(self.n_inflight_lost)
+            if self.n_inflight_lost is not None else "—"
+        )
         return (
             f"{self.label[:28]:<30}{self.detector:<22}{self.severity:<8}"
-            f"{onset:>8}  {(self.config_hash or '—')[:12]:<14}"
+            f"{onset:>8}{ev:>9}{lost:>6}  {(self.config_hash or '—')[:12]:<14}"
             f"{(self.algorithm or '—'):<18}"
             f"{(self.remediation_outcome or '—'):<12}{self.message[:48]}"
         )
 
 
 _INCIDENT_HEADER = (
-    f"{'label':<30}{'detector':<22}{'sev':<8}{'onset':>8}  "
-    f"{'config_hash':<14}{'algorithm':<18}{'remediation':<12}message"
+    f"{'label':<30}{'detector':<22}{'sev':<8}{'onset':>8}{'event':>9}"
+    f"{'lost':>6}  {'config_hash':<14}{'algorithm':<18}"
+    f"{'remediation':<12}message"
 )
 
 
@@ -241,6 +252,8 @@ def build_incident_index(root, **filters) -> list[IncidentRecord]:
         cfg = blob.get("config") or {}
         rem = blob.get("remediation")
         rem = rem if isinstance(rem, dict) else {}
+        actx = (blob.get("context") or {}).get("async")
+        actx = actx if isinstance(actx, dict) else {}
         rec = IncidentRecord(
             path=str(path),
             line=line,
@@ -254,6 +267,8 @@ def build_incident_index(root, **filters) -> list[IncidentRecord]:
             algorithm=cfg.get("algorithm") if isinstance(cfg, dict) else None,
             remediation_policy=rem.get("policy"),
             remediation_outcome=rem.get("outcome"),
+            onset_event=actx.get("onset_event"),
+            n_inflight_lost=actx.get("n_inflight_lost_window"),
         )
         if _matches(rec, filters):
             records.append(rec)
@@ -391,6 +406,34 @@ def compare_manifests(a: dict, b: dict) -> dict:
 
     inc_a, inc_b = inc_block(ha), inc_block(hb)
     rem_a, rem_b = rem_outcomes(a, ha), rem_outcomes(b, hb)
+
+    def async_ctx(blob):
+        # Event-clock fault context (ISSUE-17): present when comparing
+        # incident-bundle JSONL lines for async faulty runs.
+        ctx = blob.get("context")
+        actx = ctx.get("async") if isinstance(ctx, dict) else None
+        if not isinstance(actx, dict):
+            return None
+        return {
+            k: actx.get(k)
+            for k in ("onset_event", "n_inflight_lost_window",
+                      "window_availability", "crashed_workers_at_onset")
+            if k in actx
+        }
+
+    actx_a, actx_b = async_ctx(a), async_ctx(b)
+    async_delta = None
+    if actx_a is not None or actx_b is not None:
+        av_a = (actx_a or {}).get("window_availability")
+        av_b = (actx_b or {}).get("window_availability")
+        async_delta = {
+            "a": actx_a,
+            "b": actx_b,
+            "availability_delta": (
+                av_b - av_a if av_a is not None and av_b is not None
+                else None
+            ),
+        }
     return {
         "a": {"label": a.get("label") or a.get("artifact"),
               "config_hash": a.get("config_hash")},
@@ -430,6 +473,9 @@ def compare_manifests(a: dict, b: dict) -> dict:
                 ),
             },
         },
+        # Event-clock fault-context delta (ISSUE-17): None unless at
+        # least one side is an incident bundle carrying an async block.
+        "async_context": async_delta,
     }
 
 
@@ -515,6 +561,25 @@ PERF_TOLERANCES: dict[str, tuple[Check, ...]] = {
         Check("gates.jax_vs_numpy_per_event_parity_max_dev_f64",
               rtol=1.0, atol_floor=1e-12, direction="max"),
     ),
+    "async_faults.json": (
+        # Faults on the event clock (ISSUE-17): the crash-free bitwise
+        # gate, the no-free-lunch and matched-availability flags must
+        # reproduce exactly; the tracker residual is an f64 exactness
+        # ceiling; the under-faults barrier speedup and the
+        # churn-vs-thinning envelope get generous envelopes (latency
+        # draws are seeded, but ε-crossing indices quantize at the eval
+        # cadence).
+        Check("gates.*", equal=True, bool_only=True),
+        Check("gates.tracking_residual_max", rtol=1.0,
+              atol_floor=1e-12, direction="max"),
+        Check("gates.tracking_residual_staleness_zero", rtol=1.0,
+              atol_floor=1e-12, direction="max"),
+        Check("gates.wall_clock_speedup_under_faults", rtol=0.4,
+              direction="min"),
+        Check("runs.crash_free_gate.bitwise_*", equal=True),
+        Check("runs.matched_availability.faulty_vs_faulty_envelope",
+              rtol=0.7, direction="max", atol_floor=1.0),
+    ),
     "federated.json": (
         Check("gates.max_n_completed_matrix_free", equal=True),
         Check("gates.best_floats_to_eps_reduction", rtol=0.5,
@@ -541,6 +606,11 @@ PERF_TOLERANCES: dict[str, tuple[Check, ...]] = {
         # degradation — plus the exact cell counts must reproduce.
         Check("gates.*", equal=True, bool_only=True),
         Check("gates.agreement_cells", equal=True),
+        # Composition closure (ISSUE-17): the fixed sample's valid-cell
+        # count/fraction are committed numbers — a regen that shrinks
+        # them has re-grown a rejection rule.
+        Check("gates.agreement_valid_cells", equal=True),
+        Check("gates.agreement_valid_fraction", equal=True),
         Check("gates.matrix_n_valid_cells", equal=True),
         Check("matrix.counts.valid", equal=True),
         Check("matrix.invariants.failures", equal=True),
@@ -809,6 +879,23 @@ def _cmd_compare(args) -> int:
             f"  remediation: {rem['a'] or ['none']} vs "
             f"{rem['b'] or ['none']} "
             f"(remediated delta {rem['delta_remediated']:+d})"
+        )
+    actx = diff.get("async_context")
+    if actx:
+        sa, sb = actx["a"] or {}, actx["b"] or {}
+        print(
+            "  async fault context: "
+            f"availability {sa.get('window_availability')} vs "
+            f"{sb.get('window_availability')}"
+            + (
+                f" (delta {actx['availability_delta']:+.3f})"
+                if actx["availability_delta"] is not None else ""
+            )
+        )
+        print(
+            f"    in-flight losses: {sa.get('n_inflight_lost_window')} vs "
+            f"{sb.get('n_inflight_lost_window')}; onset event "
+            f"{sa.get('onset_event')} vs {sb.get('onset_event')}"
         )
     return 0
 

@@ -214,7 +214,10 @@ def sample_durations(
     )
 
 
-def _round_matchings(topo: Topology, horizon: int, seed: int) -> np.ndarray:
+def _round_matchings(
+    topo: Topology, horizon: int, seed: int,
+    schedule: str = "one_peer",
+) -> np.ndarray:
     """[horizon, N] per-round partner involutions P_k — the EXACT draws the
     synchronous one-peer schedule realizes.
 
@@ -224,6 +227,14 @@ def _round_matchings(topo: Topology, horizon: int, seed: int) -> np.ndarray:
     build_fault_timeline convention: schedules may be unrolled with jax,
     math twins stay independent. Per-t fold_in keys make the array
     prefix-stable in the horizon.
+
+    ``schedule``: the event axis realizes the same matching schedules the
+    synchronous paths run — ``'one_peer'`` (and ``'synchronous'``, the
+    config default, which on the event axis NAMES the same sampled
+    matchings) draws the mutual random matching per round;
+    ``'round_robin'`` cycles the deterministic edge-coloring phases
+    (``parallel/matchings.py::round_robin_partners``), identical to the
+    synchronous round-robin realization round for round.
     """
     import jax
     import jax.numpy as jnp
@@ -232,6 +243,20 @@ def _round_matchings(topo: Topology, horizon: int, seed: int) -> np.ndarray:
         sample_one_peer_matching,
     )
 
+    if schedule == "round_robin":
+        from distributed_optimization_tpu.parallel.matchings import (
+            round_robin_partners,
+        )
+
+        phases = np.asarray(round_robin_partners(topo), dtype=np.int64)
+        reps = -(-horizon // phases.shape[0])  # ceil-div
+        return np.tile(phases, (reps, 1))[:horizon]
+    if schedule not in ("one_peer", "synchronous"):
+        raise ValueError(
+            f"unknown event matching schedule {schedule!r}; known: "
+            "'synchronous'/'one_peer' (sampled mutual matchings) and "
+            "'round_robin' (deterministic phases)"
+        )
     if topo.is_matrix_free:
         # Unreachable from the shipped async path (config rejects
         # execution='async' with topology_impl='neighbor'); densifying
@@ -274,6 +299,7 @@ def build_event_timeline(
     latency_model: str = "constant",
     latency_mean: float = 1.0,
     latency_tail: float = 0.0,
+    gossip_schedule: str = "one_peer",
 ) -> EventTimeline:
     """Unroll the asynchronous execution into a static event schedule.
 
@@ -307,7 +333,7 @@ def build_event_timeline(
     # k-th event, so each matched pair exchanges exactly once per round —
     # the one-peer comms budget — while non-initiators fire solo local
     # steps at their own pace.
-    P = _round_matchings(topo, horizon, seed)
+    P = _round_matchings(topo, horizon, seed, schedule=gossip_schedule)
     idx = np.arange(n, dtype=np.int64)[None, :]
     initiates = (P != idx) & (idx < P)
     partner_kn = np.where(initiates, P, idx)
@@ -398,6 +424,193 @@ def staleness_histogram(
         "mean": float(s.mean()) if s.size else 0.0,
         "max": int(s.max()) if s.size else 0,
     }
+
+
+# --- event-indexed fault processes (ISSUE-17 tentpole) ---------------------
+#
+# The round-clock fault chains (``parallel/faults.py::FaultTimeline``) are
+# realized ON THE EVENT AXIS by indexing every [horizon, N]/[horizon, E]
+# chain at the firing worker's OWN local step: worker i's k-th event
+# consults ``node_up[k, i]``, its partner's liveness at ``node_up[k, j]``,
+# and the pair's edge chain at row k. Because each worker walks rounds at
+# its own pace, this is exactly "the round clock, experienced locally" —
+# and at constant latency (where local step == global round for every
+# event) the realization collapses BITWISE onto the round-clock arrays
+# (tests/test_async_faults.py pins it).
+
+
+@dataclasses.dataclass(frozen=True)
+class EventFaultRealization:
+    """Per-event realization of a round-indexed fault timeline (host arrays).
+
+    Semantics (docs/ASYNC.md "Faults on the event clock"):
+
+    - ``fire[e]`` False — the firing worker was crashed (mid-flight loss:
+      the in-progress gradient is discarded, nothing is written) or
+      sampled out by participation thinning (the event is skipped at the
+      matched rate). The event is a total no-op.
+    - ``partner[e]`` — the EFFECTIVE partner: the schedule's partner when
+      the exchange is alive (both endpoints up and sampled in, edge chain
+      up), else the worker itself — the pairing degrades to the solo
+      local-step path the schedule already has for unmatched workers.
+    - ``rejoin[e]`` True — the worker's first fired event after an outage
+      (the round-clock ``FaultTimeline.rejoin`` record, experienced at the
+      worker's own pace): the re-entry point where the ``frozen`` /
+      ``neighbor_restart`` rejoin policies apply.
+
+    Diagnostics: ``n_inflight_lost`` counts crash no-ops (gradients lost
+    mid-flight), ``n_thinned`` participation skips, ``n_degraded`` fired
+    matched events whose exchange died (solo fallback);
+    ``matched_fired[e]`` marks the events that realized a live pairwise
+    exchange — the realized comms accounting bills exactly these.
+    """
+
+    fire: np.ndarray           # [E] bool
+    partner: np.ndarray        # [E] int32 effective partner (== worker: solo)
+    rejoin: np.ndarray         # [E] bool
+    matched_fired: np.ndarray  # [E] bool
+    n_inflight_lost: int
+    n_thinned: int
+    n_degraded: int
+
+    @property
+    def availability(self) -> float:
+        """Realized per-event availability: fired events / all events."""
+        return float(self.fire.mean()) if self.fire.size else 1.0
+
+
+def _edge_id_table(n: int, edge_index: np.ndarray) -> np.ndarray:
+    """[N, N] int64 symmetric (i, j) -> edge-chain row lookup (-1: no edge)."""
+    eid = np.full((n, n), -1, dtype=np.int64)
+    rows = np.arange(edge_index.shape[0], dtype=np.int64)
+    eid[edge_index[:, 0], edge_index[:, 1]] = rows
+    eid[edge_index[:, 1], edge_index[:, 0]] = rows
+    return eid
+
+
+def realize_event_faults(timeline, faults) -> EventFaultRealization:
+    """Realize a round-indexed ``FaultTimeline`` on the event axis.
+
+    Every chain is indexed at the firing worker's LOCAL step (its own
+    round count), so the realization is a pure host-side function of the
+    two timelines — both backends, the diagnostics, and the incident
+    forensics consume the identical arrays (the ``build_fault_timeline``
+    purity contract, lifted to events). ``faults.horizon`` must cover the
+    schedule's per-worker rounds.
+    """
+    if faults.horizon < timeline.n_rounds:
+        raise ValueError(
+            f"fault timeline horizon {faults.horizon} does not cover the "
+            f"event schedule's {timeline.n_rounds} per-worker rounds"
+        )
+    E = timeline.n_events
+    n = timeline.n_workers
+    k = timeline.local_step.astype(np.int64)
+    i = timeline.worker.astype(np.int64)
+    j = timeline.partner.astype(np.int64)
+
+    def alive(node):
+        """Up AND sampled-in at the node's row of the event's step."""
+        a = np.ones(E, dtype=bool)
+        if faults.node_up is not None:
+            a &= faults.node_up[k, node]
+        if faults.part_up is not None:
+            a &= faults.part_up[k, node]
+        return a
+
+    worker_up = (
+        faults.node_up[k, i] if faults.node_up is not None
+        else np.ones(E, dtype=bool)
+    )
+    worker_in = (
+        faults.part_up[k, i] if faults.part_up is not None
+        else np.ones(E, dtype=bool)
+    )
+    fire = worker_up & worker_in
+    matched = j != i
+    exchange = fire & matched & alive(j)
+    if faults.edge_up is not None:
+        eid = _edge_id_table(n, faults.edge_index)
+        ids = eid[i, j]
+        exchange &= (ids >= 0) & faults.edge_up[k, np.maximum(ids, 0)]
+    partner_eff = np.where(exchange, j, i).astype(np.int32)
+    rejoin = (
+        (faults.rejoin[k, i] & fire) if faults.rejoin is not None
+        else np.zeros(E, dtype=bool)
+    )
+    return EventFaultRealization(
+        fire=fire,
+        partner=partner_eff,
+        rejoin=rejoin,
+        matched_fired=exchange,
+        n_inflight_lost=int(np.sum(~worker_up)),
+        n_thinned=int(np.sum(worker_up & ~worker_in)),
+        n_degraded=int(np.sum(fire & matched & ~exchange)),
+    )
+
+
+def all_up_realization(timeline) -> EventFaultRealization:
+    """The degenerate fault-free realization: every event fires, every
+    scheduled exchange is live. Exists for the crash-free bitwise gate —
+    threading THESE masks through the fault-aware program must reproduce
+    the unmasked program's trajectory exactly."""
+    matched = timeline.partner != timeline.worker
+    return EventFaultRealization(
+        fire=np.ones(timeline.n_events, dtype=bool),
+        partner=timeline.partner.copy(),
+        rejoin=np.zeros(timeline.n_events, dtype=bool),
+        matched_fired=matched,
+        n_inflight_lost=0,
+        n_thinned=0,
+        n_degraded=0,
+    )
+
+
+def rejoin_restart_rows(
+    timeline, faults, realization: EventFaultRealization, topo: Topology,
+) -> np.ndarray:
+    """[E, N] float64 warm-restart weight rows for ``neighbor_restart``.
+
+    Zero rows except at rejoin events, where the row is the normalized
+    indicator of the rejoining worker's ALIVE realized neighborhood at
+    its re-entry step (base-topology neighbors that are up, sampled in,
+    and — when an edge chain is active — connected by a live edge). A
+    rejoiner with no alive neighbor gets the one-hot self row, i.e. it
+    keeps its frozen state — the same fallback the synchronous
+    ``rejoin_restart`` path applies. The backend applies
+    ``x_i <- w_e @ x`` (and re-reads) at rejoin events BEFORE the update;
+    tracker leaves are never restarted, preserving the gradient-tracking
+    invariant through every outage.
+    """
+    E = timeline.n_events
+    n = timeline.n_workers
+    W = np.zeros((E, n))
+    ev_ids = np.flatnonzero(realization.rejoin)
+    if ev_ids.size == 0:
+        return W
+    A = np.asarray(topo.adjacency, dtype=np.float64)
+    eid = (
+        _edge_id_table(n, faults.edge_index)
+        if faults.edge_up is not None else None
+    )
+    for e in ev_ids:
+        kk = int(timeline.local_step[e])
+        ii = int(timeline.worker[e])
+        row = A[ii].copy()
+        if faults.node_up is not None:
+            row *= faults.node_up[kk]
+        if faults.part_up is not None:
+            row *= faults.part_up[kk]
+        if eid is not None:
+            ids = eid[ii]
+            live = (ids >= 0) & faults.edge_up[kk, np.maximum(ids, 0)]
+            row *= live
+        deg = row.sum()
+        if deg > 0:
+            W[e] = row / deg
+        else:
+            W[e, ii] = 1.0
+    return W
 
 
 def clock_skew(timeline: EventTimeline, *, rounds=None) -> dict:

@@ -358,24 +358,51 @@ def _check_checkpoint_resume(ctx: CellContext) -> InvariantResult:
     n_evals = cfg.n_iterations // cfg.eval_every
     every = max(1, n_evals // 4)
     half_evals = max(every, (n_evals // 2 // every) * every)
-    half_cfg = cfg.replace(n_iterations=half_evals * cfg.eval_every)
     workdir = ctx.engine.workdir(
         f"ckpt-{ctx.cell.index}-{cfg.structural_hash()}"
     )
     ref = ctx.run_direct(cfg, checkpoint=CheckpointOptions(
         os.path.join(workdir, "ref"), every_evals=every, resume=False,
     ))
-    # The "interrupted" run: half the horizon, then resume to the full
-    # horizon from its last saved chunk.
-    ctx.run_direct(half_cfg, checkpoint=CheckpointOptions(
-        os.path.join(workdir, "resume"), every_evals=every, resume=False,
-    ))
+    resume_dir = os.path.join(workdir, "resume")
+    if cfg.execution == "async":
+        # The event schedule is horizon-global, so a shorter-horizon run
+        # is a DIFFERENT event sequence — n_iterations is not resumable
+        # on the event clock (the RunCheckpointer sidecar pins it).
+        # Interrupt instead by dropping every chunk past the midpoint of
+        # a full run; resume replays the suffix from the surviving
+        # mid-schedule chunk (the PR 3 truncated-chunk fallback path).
+        import shutil
+
+        from distributed_optimization_tpu.utils.checkpoint import (
+            RunCheckpointer,
+        )
+
+        opts = CheckpointOptions(
+            resume_dir, every_evals=every, resume=False,
+        )
+        ctx.run_direct(cfg, checkpoint=opts)
+        ck = RunCheckpointer(opts)
+        chunks = ck.completed_chunks()
+        # Retention (max_to_keep) already dropped the earliest saves;
+        # keep only the earliest SURVIVING chunk so the resume genuinely
+        # replays a mid-schedule suffix.
+        for chunk in chunks[1:]:
+            shutil.rmtree(ck._step_dir(chunk), ignore_errors=True)
+        half_evals = chunks[0] if chunks else half_evals
+    else:
+        # The "interrupted" run: half the horizon, then resume to the
+        # full horizon from its last saved chunk.
+        half_cfg = cfg.replace(n_iterations=half_evals * cfg.eval_every)
+        ctx.run_direct(half_cfg, checkpoint=CheckpointOptions(
+            resume_dir, every_evals=every, resume=False,
+        ))
     resumed = ctx.run_direct(cfg, checkpoint=CheckpointOptions(
-        os.path.join(workdir, "resume"), every_evals=every, resume=True,
+        resume_dir, every_evals=every, resume=True,
     ))
     detail = _bitwise(ref, resumed)
     detail["every_evals"] = every
-    detail["interrupted_at_iteration"] = half_cfg.n_iterations
+    detail["interrupted_at_iteration"] = half_evals * cfg.eval_every
     return InvariantResult(
         "checkpoint_resume",
         detail["objective_bitwise"] and detail["final_models_bitwise"],
@@ -428,8 +455,15 @@ CATALOG: dict[str, Invariant] = {
             # O(payload), so the invariant's own applicability boundary
             # is plain gossip (the engine smoke that found this is why
             # the catalog encodes it).
+            # Applies on BOTH clocks (ISSUE-17): the async event update's
+            # per-event telescoping (y_i' picks up g_new − g_prev_i, the
+            # pair averages preserve both means) keeps the identity exact
+            # at ANY staleness, under event-realized crash/participation
+            # faults included — a no-op event changes nothing and a
+            # degraded self-exchange averages a row with itself.
             lambda cfg: (
-                cfg.algorithm == "gradient_tracking" and _sync_jax(cfg)
+                cfg.algorithm == "gradient_tracking"
+                and cfg.backend == "jax"
                 and cfg.attack == "none" and cfg.aggregation == "gossip"
                 and cfg.rejoin == "frozen"
                 and cfg.worker_mesh == 0 and cfg.replicas == 1
@@ -472,9 +506,15 @@ CATALOG: dict[str, Invariant] = {
         ),
         Invariant(
             "reduction_churn",
+            # Holds on the event clock too (ISSUE-17): the event
+            # realization reads the same (seed, horizon)-pure chains at
+            # (local_step, worker), and iid stragglers collapse to churn
+            # at mttf=1/q bitwise at the CHAIN level, so the realized
+            # fire/partner arrays — and hence the scanned program — are
+            # identical.
             lambda cfg: (
                 cfg.straggler_prob > 0.0 and cfg.mttf == 0.0
-                and _sync_jax(cfg)
+                and cfg.backend == "jax"
                 and cfg.gossip_schedule == "synchronous"
                 and cfg.worker_mesh == 0 and cfg.replicas == 1
             ),
@@ -502,8 +542,13 @@ CATALOG: dict[str, Invariant] = {
         ),
         Invariant(
             "checkpoint_resume",
+            # Async runs checkpoint on the same RunCheckpointer chunk
+            # grammar (ISSUE-17): an eval row is a chunk, the event
+            # cursor is chunk·eval_every·N, and restore replays the
+            # suffix bitwise (prefix-stable schedules + counter-based
+            # batch draws).
             lambda cfg: (
-                _sync_jax(cfg) and cfg.replicas == 1
+                cfg.backend == "jax" and cfg.replicas == 1
                 and cfg.worker_mesh == 0 and cfg.tp_degree == 1
                 and not cfg.telemetry
                 and cfg.n_iterations // cfg.eval_every >= 4

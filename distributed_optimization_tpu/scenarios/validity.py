@@ -590,11 +590,13 @@ RULES: tuple[Rule, ...] = (
        lambda f: "execution='async' is unsupported on the cpp backend"),
     _r("async×algorithm", ("execution", "algorithm"),
        lambda f: f["execution"] == "async" and f["backend"] != "cpp"
-       and f["algorithm"] != "dsgd",
+       and f["algorithm"] not in ("dsgd", "gradient_tracking"),
        lambda f: (
            f"execution='async' is unsupported for {f['algorithm']!r}: an "
-           "event applies one worker's D-PSGD update — use "
-           "algorithm='dsgd'"
+           "event applies ONE worker's update at its realized staleness — "
+           "only dsgd and gradient tracking's per-event tracker "
+           "telescoping have an event form; use algorithm='dsgd' or "
+           "'gradient_tracking'"
        )),
     _r("async×directed", ("execution", "topology"),
        lambda f: f["execution"] == "async"
@@ -603,21 +605,13 @@ RULES: tuple[Rule, ...] = (
            "execution='async' realizes mutual pairwise exchanges; "
            f"directed topology {f['topology']!r} has one-way links"
        )),
-    _r("async×schedule", ("execution", "topology"),
-       lambda f: f["execution"] == "async"
-       and f["gossip_schedule"] != "synchronous",
-       lambda f: (
-           "execution='async' IS a gossip schedule; leave "
-           "gossip_schedule='synchronous'"
-       )),
-    _r("async×faults", ("execution", "faults", "participation"),
-       lambda f: f["execution"] == "async" and (
-           f["edge_drop_prob"] > 0.0 or f["straggler_prob"] > 0.0
-           or f["mttf"] > 0.0 or f["participation_rate"] < 1.0),
-       lambda f: (
-           "execution='async' models stragglers as latency, not drops; "
-           "round-indexed fault processes have no event-schedule form"
-       )),
+    # ISSUE-17 deleted the async×schedule and async×faults rejections:
+    # gossip_schedule now has an event-axis meaning ('synchronous'/
+    # 'one_peer' name the sampled mutual matchings, 'round_robin' the
+    # deterministic phase rotation) and the round-indexed fault knobs
+    # (edge_drop/straggler/mttf/participation) are realized on the event
+    # axis by parallel.events.realize_event_faults.  The surviving
+    # churn×schedule / participation×schedule rules below still apply.
     _r("async×byzantine", ("execution", "byzantine"),
        lambda f: f["execution"] == "async"
        and (f["attack"] != "none" or _robust_rule_on(f)),
@@ -631,12 +625,9 @@ RULES: tuple[Rule, ...] = (
        lambda f: (
            "execution='async' does not compose with compressed gossip"
        )),
-    _r("async×local_steps", ("execution", "local_steps"),
-       lambda f: f["execution"] == "async" and f["local_steps"] > 1,
-       lambda f: (
-           "execution='async' already decouples gradient steps from "
-           "exchanges; local_steps > 1 is a round-based lever"
-       )),
+    # ISSUE-17 deleted async×local_steps: τ local descents fuse into one
+    # event (the firing worker chains τ stale-read minibatch steps before
+    # its pairwise exchange), so the round-based lever composes.
     _r("async×tp_replicas", ("execution", "replicas"),
        lambda f: f["execution"] == "async"
        and (f["tp_degree"] > 1 or f["replicas"] > 1),
@@ -651,12 +642,9 @@ RULES: tuple[Rule, ...] = (
            "execution='async' scans events over the dense topology "
            "representation"
        )),
-    _r("async×telemetry", ("execution",),
-       lambda f: f["execution"] == "async" and f["telemetry"],
-       lambda f: (
-           "execution='async' records no in-scan trace buffers — set "
-           "telemetry=False"
-       )),
+    # ISSUE-17 deleted async×telemetry: trace rows now ride the event
+    # scan's per-eval outputs (grad/param norms, per-worker event-fire
+    # fractions, live-edge rates), so telemetry=True composes.
     # ---------------------------------------------------------- schedule
     _domain("gossip_schedule", "topology",
             ("synchronous", "one_peer", "round_robin")),

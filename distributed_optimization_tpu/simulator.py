@@ -231,10 +231,11 @@ class Simulator:
             or cfg.worker_mesh >= 2
             or (monitors is not None and monitors.anomalies)
         ):
-            # Async runs carry no in-scan trace buffers, but their health
-            # block (staleness histogram, virtual-clock skew, floats per
-            # virtual second) derives from the presampled event timeline
-            # — always available, so always surfaced (docs/ASYNC.md).
+            # Async health (staleness histogram, virtual-clock skew,
+            # floats per virtual second, the event-fault block under
+            # churn/thinning) derives from the presampled event timeline
+            # — always available even without the opt-in in-scan trace,
+            # so always surfaced (docs/ASYNC.md).
             # Sharded worker-mesh runs likewise: the bytes-over-ICI block
             # derives from the static halo plan (docs/PERF.md §16).
             from distributed_optimization_tpu.telemetry import health_summary

@@ -519,8 +519,13 @@ def async_summary(config, *, rounds=None) -> Optional[dict]:
     from distributed_optimization_tpu.backends.async_scan import timeline_for
     from distributed_optimization_tpu.parallel.events import (
         clock_skew,
+        realize_event_faults,
         staleness_histogram,
         sync_round_times,
+    )
+    from distributed_optimization_tpu.parallel.faults import (
+        config_faults_active,
+        timeline_for_config,
     )
 
     # Shares the backend's own cached build (timeline_for's LRU): the
@@ -539,6 +544,42 @@ def async_summary(config, *, rounds=None) -> Optional[dict]:
     )
     svt = sync_round_times(tl)
     s_start = float(svt[start_r - 1]) if start_r else 0.0
+    faults: Optional[dict] = None
+    if config_faults_active(config):
+        # Event-realized fault diagnostics (ISSUE-17): the SAME
+        # (seed, horizon)-pure realization the backends executed —
+        # availability is the fired-event fraction, in-flight losses are
+        # crashed firing workers (their stale gradient evaporates),
+        # thinned events are participation draws, degraded exchanges are
+        # live firings whose partner (or edge) was down and fell back to
+        # the self-loop.
+        from distributed_optimization_tpu.parallel import build_topology
+        topo = build_topology(
+            config.topology, config.n_workers,
+            erdos_renyi_p=config.erdos_renyi_p,
+            seed=config.resolved_topology_seed(),
+        )
+        ft = timeline_for_config(config, topo, tl.n_rounds)
+        real = realize_event_faults(tl, ft)
+        fire_w = real.fire[sl]
+        kk = tl.local_step.astype(np.int64)
+        ww = tl.worker.astype(np.int64)
+        ones = np.ones(len(ww), dtype=bool)
+        worker_up = ft.node_up[kk, ww] if ft.node_up is not None else ones
+        worker_in = ft.part_up[kk, ww] if ft.part_up is not None else ones
+        faults = {
+            "availability": (
+                float(fire_w.mean()) if fire_w.size else 1.0
+            ),
+            # Crash no-ops (the in-flight gradient evaporated) vs
+            # participation skips — the EventFaultRealization split,
+            # windowed to the executed slice.
+            "n_inflight_lost": int((~worker_up[sl]).sum()),
+            "n_thinned": int((worker_up & ~worker_in)[sl].sum()),
+            "n_degraded_exchanges": int(real.n_degraded),
+            "n_rejoin_events": int(real.rejoin[sl].sum()),
+            "matched_fired": int(real.matched_fired[sl].sum()),
+        }
     return {
         "latency_model": config.latency_model,
         "latency_mean": float(config.latency_mean),
@@ -556,6 +597,7 @@ def async_summary(config, *, rounds=None) -> Optional[dict]:
         "sync_virtual_duration": (
             float(svt[stop_r - 1]) - s_start if stop_r > start_r else 0.0
         ),
+        "faults": faults,
     }
 
 

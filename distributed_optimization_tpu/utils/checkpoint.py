@@ -67,18 +67,27 @@ class RunCheckpointer:
     _CONFIG_SIDECAR = "run_config.json"
     _RESUMABLE_KEYS = frozenset({"n_iterations"})
 
-    def validate_or_record_config(self, config) -> None:
+    def validate_or_record_config(
+        self, config, resumable_keys: Optional[frozenset] = None,
+    ) -> None:
         """First save records the config; later runs must match it.
 
         Raises ValueError naming the mismatched fields when the directory was
-        written by a different experiment.
+        written by a different experiment. ``resumable_keys`` overrides the
+        class default: the async event path passes ``frozenset()`` because
+        its event schedule is horizon-GLOBAL (events interleave across
+        rounds by completion time), so extending ``n_iterations`` would
+        replay a different event prefix than the one the saved chunks
+        executed — not a legitimate resume.
         """
         import json
 
+        if resumable_keys is None:
+            resumable_keys = self._RESUMABLE_KEYS
         path = os.path.join(self.directory, self._CONFIG_SIDECAR)
         current = {
             k: v for k, v in config.to_dict().items()
-            if k not in self._RESUMABLE_KEYS
+            if k not in resumable_keys
         }
         if not os.path.exists(path):
             with open(path, "w") as f:
@@ -98,14 +107,18 @@ class RunCheckpointer:
                 "(--no-resume) to clear it and start fresh"
             )
 
-    def reset(self, config) -> None:
+    def reset(
+        self, config, resumable_keys: Optional[frozenset] = None,
+    ) -> None:
         """Start the directory fresh for a ``resume=False`` run.
 
         Clears every existing chunk checkpoint (a fresh run that leaves stale
         higher-numbered chunks behind would poison a LATER resume) and
         rewrites the config sidecar, so reusing a directory written by a
         different experiment is allowed when the caller explicitly opted out
-        of resuming.
+        of resuming. ``resumable_keys`` is forwarded to the sidecar write so
+        a caller that pins extra fields (the async event path pins
+        ``n_iterations``) records them for its own later resumes.
         """
         import contextlib
         import shutil
@@ -114,7 +127,8 @@ class RunCheckpointer:
             shutil.rmtree(self._step_dir(chunk), ignore_errors=True)
         with contextlib.suppress(FileNotFoundError):
             os.remove(os.path.join(self.directory, self._CONFIG_SIDECAR))
-        self.validate_or_record_config(config)  # first-write path: records
+        # First-write path: records.
+        self.validate_or_record_config(config, resumable_keys)
 
     def completed_chunks(self) -> list[int]:
         """Chunk numbers with a plausibly-complete checkpoint directory.

@@ -54,7 +54,7 @@ BASE = {
     "dtype": "float64",
 }
 
-# The golden matrix: 35 curated compositions × 2 learning rates = 70
+# The golden matrix: 41 curated compositions × 2 learning rates = 82
 # cells, every one VALID by construction (the spec is committed evidence
 # that these compositions run, not a sampler exercise — the sampler's
 # valid/invalid frontier is gated by the agreement block instead).
@@ -99,6 +99,23 @@ SCENARIOS = [
     {"execution": "async", "latency_model": "exponential"},
     {"execution": "async", "latency_model": "pareto",
      "latency_tail": 1.5},
+    # Async-faulty cells (ISSUE-17): faults realized on the EVENT axis —
+    # compositions the validity table rejected before the event-clock
+    # fault substrate landed. Each exercises a deleted rejection rule:
+    # churn, participation thinning, gradient tracking's per-event
+    # telescoping, τ fused per event, straggler-churn collapse, rejoin.
+    {"execution": "async", "latency_model": "lognormal",
+     "latency_tail": 0.5, "mttf": 40.0, "mttr": 15.0},
+    {"execution": "async", "latency_model": "exponential",
+     "participation_rate": 0.5},
+    {"execution": "async", "latency_model": "lognormal",
+     "latency_tail": 0.5, "algorithm": "gradient_tracking"},
+    {"execution": "async", "latency_model": "exponential",
+     "local_steps": 2},
+    {"execution": "async", "latency_model": "exponential",
+     "straggler_prob": 0.15},
+    {"execution": "async", "latency_model": "exponential",
+     "mttf": 40.0, "mttr": 15.0, "rejoin": "neighbor_restart"},
     {"replicas": 3},
     {"worker_mesh": 2},
     {"worker_mesh": 2, "straggler_prob": 0.15},
@@ -214,6 +231,15 @@ def axes_coverage(report) -> dict:
             lambda o: o.get("participation_rate", 1.0) < 1.0
         ),
         "execution": has(lambda o: o.get("execution") == "async"),
+        # ISSUE-17: the event clock carries a fault process — churn or
+        # thinning composed WITH execution='async' in one valid cell.
+        "async_faults": has(
+            lambda o: o.get("execution") == "async" and (
+                o.get("mttf", 0) > 0
+                or o.get("participation_rate", 1.0) < 1.0
+                or o.get("straggler_prob", 0) > 0
+            )
+        ),
         "replicas": has(lambda o: o.get("replicas", 1) > 1),
         "worker_mesh": has(lambda o: o.get("worker_mesh", 0) >= 2),
     }
@@ -375,6 +401,15 @@ def main() -> int:
         "gates": {
             "agreement_zero_divergences": not divergences,
             "agreement_cells": agreement["cells"],
+            # The composition-closure number (ISSUE-17): the FIXED seeded
+            # sample's valid fraction. Every deleted async rejection rule
+            # moves cells from rejected to valid, so this committed
+            # fraction must strictly increase whenever closure grows —
+            # and must reproduce exactly on regen (perf-diff guarded).
+            "agreement_valid_cells": agreement["counts"]["valid"],
+            "agreement_valid_fraction": round(
+                agreement["counts"]["valid"] / agreement["cells"], 4
+            ),
             "matrix_n_valid_cells": n_valid,
             "matrix_axes_covered": all(coverage.values()),
             "matrix_all_cells_completed": report["gates"][

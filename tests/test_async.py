@@ -413,25 +413,39 @@ def test_executable_cache_hit_is_bitwise(setup):
 def test_config_rejections():
     ok = dict(execution="async")
     for bad, match in [
-        (dict(algorithm="gradient_tracking"), "per-event form"),
-        (dict(edge_drop_prob=0.2), "stragglers as LATENCY"),
-        (dict(participation_rate=0.5), "stragglers as LATENCY"),
-        (dict(mttf=10.0, mttr=5.0), "stragglers as LATENCY"),
+        (dict(algorithm="extra"), "dsgd"),
+        (dict(algorithm="push_sum"), "dsgd"),
         (dict(attack="sign_flip", n_byzantine=1), "pairwise exchange"),
         (dict(aggregation="trimmed_mean", robust_b=1), "pairwise exchange"),
         (dict(compression="top_k", compression_k=4, algorithm="dsgd"),
          "compressed"),
-        (dict(local_steps=2), "round-based lever"),
         (dict(replicas=2), "totally"),
-        (dict(gossip_schedule="one_peer"), "IS a gossip schedule"),
         (dict(topology="directed_ring"), "one-way links"),
         (dict(topology_impl="neighbor", n_workers=8192,
               topology="ring"), "dense-"),
-        (dict(telemetry=True), "no in-scan trace buffers"),
         (dict(backend="cpp"), "cpp backend"),
     ]:
         with pytest.raises(ValueError, match=match):
             ExperimentConfig(**{**ok, **bad})
+    # ISSUE-17 composition closure: the event clock is a fault substrate
+    # and the async scan carries trace buffers / fused local steps — these
+    # all CONSTRUCT now (the former rejections are deleted in config and
+    # scenarios/validity.py lockstep).
+    for accepted in [
+        dict(algorithm="gradient_tracking"),
+        dict(edge_drop_prob=0.2),
+        dict(participation_rate=0.5),
+        dict(mttf=10.0, mttr=5.0),
+        dict(mttf=10.0, mttr=5.0, rejoin="neighbor_restart"),
+        dict(local_steps=2),
+        dict(local_steps=3, algorithm="gradient_tracking"),
+        dict(gossip_schedule="one_peer"),
+        dict(gossip_schedule="round_robin"),
+        dict(telemetry=True),
+        dict(straggler_prob=0.1),
+    ]:
+        cfg = ExperimentConfig(**{**ok, **accepted})
+        assert cfg.execution == "async"
     # latency knobs are async-only; tail knobs are model-specific.
     with pytest.raises(ValueError, match="silently ignore"):
         ExperimentConfig(latency_tail=1.0)
@@ -450,9 +464,12 @@ def test_runner_rejections(setup):
         CheckpointOptions,
     )
 
-    with pytest.raises(ValueError, match="round-chunked checkpoint"):
+    # Checkpointing composes with async now (ISSUE-17); the remaining
+    # exclusions are telemetry trace buffers (not checkpointed) and an
+    # explicit state0/start_event cursor (the chunk IS the cursor).
+    with pytest.raises(ValueError, match="not checkpointed"):
         jax_backend.run(
-            CFG, ds, f_opt,
+            CFG.replace(telemetry=True), ds, f_opt,
             checkpoint=CheckpointOptions(directory="/tmp/nope"),
         )
     with pytest.raises(ValueError, match="VIRTUAL clock"):

@@ -340,6 +340,7 @@ def test_run_preflight_names():
 # changes must be deliberate.
 PERF_ARTIFACT_KEYS = {
     "async.json": {"config", "device", "gates", "note", "runs"},
+    "async_faults.json": {"config", "device", "gates", "note", "runs"},
     "anomaly_rootcause.json": {
         "after_fix_iters_per_sec_median4_same_session",
         "cond_alternative_rejected", "device_trace_evidence", "fix",
