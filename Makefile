@@ -9,7 +9,7 @@ PY ?= python
 	bench-fused bench-serving bench-serving-load bench-fleet \
 	bench-federated \
 	bench-async bench-async-faults bench-observatory bench-mesh \
-	bench-scenarios bench-monitors
+	bench-mesh-scale bench-scenarios bench-monitors
 
 # Full fast suite (tier-1 shape, minus --continue-on-collection-errors:
 # local runs should fail loudly on broken collection).
@@ -32,6 +32,7 @@ smoke:
 		tests/test_async_faults.py \
 		tests/test_matrix_free_faults.py tests/test_observatory.py \
 		tests/test_monitors.py tests/test_worker_mesh.py \
+		tests/test_mesh_scale.py \
 		tests/test_scenarios.py tests/test_scenario_chaos.py \
 		tests/test_fleet.py
 	$(MAKE) observatory-smoke
@@ -201,3 +202,12 @@ bench-scenarios:
 # platform itself).
 bench-mesh:
 	$(PY) examples/bench_worker_mesh.py
+
+# Regenerate the million-worker mesh evidence (docs/perf/mesh_scale.json:
+# N=1M ring/torus sharded completions over 16 forced host devices, flat
+# per-device memory at matched rows/device, the O(N·k_max) sparse ER
+# build at 1M, the <=50% compressed-halo wire cut inside the 2.5x gap
+# envelope, and the measured overlap ratio — the script forces the
+# 16-device host platform itself).
+bench-mesh-scale:
+	$(PY) examples/bench_mesh_scale.py

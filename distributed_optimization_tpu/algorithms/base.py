@@ -44,6 +44,12 @@ class StepContext:
     ``fused_mix_step``: optional backend-provided fusion of the canonical
     gossip-SGD update, (x, g, eta) -> W x − eta g in one kernel (the pallas
     fast path); algorithms whose update IS that form may use it when present.
+    ``compressed_mix``: optional sharded wire form of the error-feedback
+    exchange, (q, x̂⁺, halo) -> (W x̂⁺, halo⁺)
+    (``collectives.make_halo_compressed_mixing_op``) — present only on the
+    worker-mesh path with compression, where the state carries the
+    persistent receiver-side halo leaves; algorithms route their
+    ``ErrorFeedbackGossip`` exchanges through ``exchange_sharded`` with it.
     """
 
     grad: Callable[[Array, int], Array]
@@ -54,6 +60,7 @@ class StepContext:
     degrees: Array
     config: Any
     fused_mix_step: Any = None
+    compressed_mix: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

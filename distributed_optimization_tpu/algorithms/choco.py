@@ -66,6 +66,16 @@ def _step(state: State, ctx: StepContext) -> State:
     )
     g = ctx.grad(x, 0)
     x_half = x - ctx.eta * g
+    if ctx.compressed_mix is not None:
+        # Worker-mesh wire form: only q's boundary rows cross devices; the
+        # persistent receiver-side copy rides the xhat_halo state leaf
+        # (seeded to zeros by the backend). Local algebra is term-for-term
+        # the branch below — bitwise vs unsharded at matched N.
+        x_new, xhat_new, halo_new = ef.exchange_sharded(
+            compression_key(cfg.seed, ctx.t), x_half, xhat,
+            state["xhat_halo"], ctx.compressed_mix,
+        )
+        return {"x": x_new, "xhat": xhat_new, "xhat_halo": halo_new}
     x_new, xhat_new = ef.exchange(
         compression_key(cfg.seed, ctx.t), x_half, xhat, ctx.mix
     )

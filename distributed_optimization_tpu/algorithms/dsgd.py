@@ -70,6 +70,16 @@ def _step(state: State, ctx: StepContext) -> State:
         )
         g = ctx.grad(x, 0)
         x_half = x - ctx.eta * g
+        if ctx.compressed_mix is not None:
+            # Worker-mesh wire form (collectives.make_halo_compressed_
+            # mixing_op): q's boundary rows over ppermute, receiver copies
+            # in the xhat_halo leaf. Same local algebra — bitwise vs the
+            # unsharded branch below at matched N.
+            x_new, xhat_new, halo_new = ef.exchange_sharded(
+                compression_key(cfg.seed, ctx.t), x_half, state["xhat"],
+                state["xhat_halo"], ctx.compressed_mix,
+            )
+            return {"x": x_new, "xhat": xhat_new, "xhat_halo": halo_new}
         x_new, xhat_new = ef.exchange(
             compression_key(cfg.seed, ctx.t), x_half, state["xhat"],
             ctx.mix,

@@ -86,6 +86,27 @@ def _step(state: State, ctx: StepContext) -> State:
             cfg.compression, x.shape[-1], cfg.compression_k,
             cfg.choco_gamma,
         )
+        if ctx.compressed_mix is not None:
+            # Worker-mesh wire form: both rounds ship only q boundary rows
+            # over ppermute; each gossiped leaf carries its own persistent
+            # receiver-side halo (xhat_halo / yhat_halo, zero-seeded by
+            # the backend). Local algebra matches the unsharded branch
+            # below term for term — bitwise at matched N.
+            x_mixed, xhat_new, xh_halo = ef.exchange_sharded(
+                compression_key(cfg.seed, ctx.t, round=0), x,
+                state["xhat"], state["xhat_halo"], ctx.compressed_mix,
+            )
+            x_new = x_mixed - ctx.eta * y
+            g_new = ctx.grad(x_new, 0)
+            y_mixed, yhat_new, yh_halo = ef.exchange_sharded(
+                compression_key(cfg.seed, ctx.t, round=1), y,
+                state["yhat"], state["yhat_halo"], ctx.compressed_mix,
+            )
+            return {
+                "x": x_new, "y": y_mixed + g_new - g_prev,
+                "g_prev": g_new, "xhat": xhat_new, "yhat": yhat_new,
+                "xhat_halo": xh_halo, "yhat_halo": yh_halo,
+            }
         x_mixed, xhat_new = ef.exchange(
             compression_key(cfg.seed, ctx.t, round=0), x, state["xhat"],
             ctx.mix,

@@ -276,6 +276,10 @@ class _StepPieces:
     # Nominal Σ_i deg_i of the static topology (the fault-free live_edges
     # row; 0.0 for centralized runs).
     static_degree_sum: float = 0.0
+    # Sharded compressed-exchange wire form (q, x̂⁺, halo) -> (W x̂⁺, halo⁺)
+    # (collectives.make_halo_compressed_mixing_op); only set on the
+    # worker-mesh path with compression != 'none'.
+    compressed_mix: object = None
 
 
 def _make_step_eval(p: _StepPieces, data):
@@ -385,6 +389,7 @@ def _make_step_eval(p: _StepPieces, data):
             degrees=p.degrees,
             config=p.config,
             fused_mix_step=fused_mix_step,
+            compressed_mix=p.compressed_mix,
         )
         new_state = p.algo.step(state, ctx)
         if faulty is not None and (
@@ -1415,11 +1420,13 @@ def _run(
 
     # --- topology & collectives (centralized needs none) ---
     halo_mesh = None
+    compressed_mix = None
     if algo.is_decentralized:
         topo = build_topology(
             config.topology, n, erdos_renyi_p=config.erdos_renyi_p,
             seed=config.resolved_topology_seed(),
             impl=config.resolved_topology_impl(),
+            sampler=config.resolved_topology_sampler(),
         )
         if config.worker_mesh >= 2:
             # Sharded worker mesh (ISSUE-11 tentpole, docs/PERF.md §16):
@@ -1451,12 +1458,23 @@ def _run(
                 mesh = make_sized_worker_mesh(config.worker_mesh)
             halo_mesh = mesh
             from distributed_optimization_tpu.parallel.collectives import (
+                make_halo_compressed_mixing_op,
                 make_halo_mixing_op,
             )
 
             mix_op = make_halo_mixing_op(
-                topo, mesh, dtype=device_data.X.dtype
+                topo, mesh, dtype=device_data.X.dtype,
+                overlap=config.halo_overlap,
             )
+            if config.compression != "none":
+                # Compressed halo exchange (ISSUE-18): the error-feedback
+                # algorithms route their wire rounds through this instead
+                # of mix_op.apply — only q boundary rows cross devices,
+                # with the receiver-side estimate copies persisted in the
+                # *_halo state leaves seeded below.
+                compressed_mix = make_halo_compressed_mixing_op(
+                    topo, mesh, dtype=device_data.X.dtype
+                )
         elif (
             mesh is None and use_mesh and len(jax.devices()) > 1
             and not topo.is_matrix_free
@@ -1627,6 +1645,21 @@ def _run(
         x0, config,
         neighbor_sum=mix_op.neighbor_sum if mix_op is not None else None,
     )
+    if compressed_mix is not None:
+        # Seed the persistent receiver-side halo copies (one per estimate
+        # leaf; [P·(h_max+1), d] row-sharded, zeros — agreeing with the
+        # zero xhat memories, which is what the bitwise induction vs the
+        # unsharded exchange starts from). A resumed state that already
+        # carries the leaves passes through untouched.
+        for _leaf in ("xhat", "yhat"):
+            if _leaf in state0 and f"{_leaf}_halo" not in state0:
+                state0[f"{_leaf}_halo"] = shard_over_workers(
+                    mesh,
+                    jnp.zeros(
+                        (compressed_mix.halo_rows, d_model),
+                        dtype=device_data.X.dtype,
+                    ),
+                )
     key = jax.random.key(config.seed)
 
     schedule = None
@@ -1709,6 +1742,7 @@ def _run(
         fused_robust_step=fused_robust_step,
         telemetry=config.telemetry, robust_activity=robust_activity,
         static_degree_sum=static_degree_sum,
+        compressed_mix=compressed_mix,
     )
 
     def make_step_eval(data):
@@ -2302,6 +2336,24 @@ def run_batch(
     """
     from distributed_optimization_tpu.backends.base import x64_scope
 
+    if config.worker_mesh >= 2:
+        # Sequential-mesh dispatch (ISSUE-18 satellite): the halo-exchange
+        # shard_map pins a fixed device mesh the replica vmap axis cannot
+        # wrap, so a sharded cohort runs as R sequential mesh runs sharing
+        # one AOT executable (seeds and swept scalars are traced inputs —
+        # replica 2..R hit the executable cache replica 1 compiled).
+        # ``batch_unsupported_reason`` still names worker_mesh so the
+        # serving coalescer routes these down its sequential path; this
+        # entry point dispatches them itself so ``replicas=R`` sweeps work
+        # at N=100k (docs/perf/scenarios.json agreement gate).
+        return _run_sequential_mesh_batch(
+            config, dataset, f_opt, seeds=seeds, sweep=sweep,
+            collect_metrics=collect_metrics,
+            measure_compile=measure_compile, state0=state0, t0=t0,
+            executable_cache=executable_cache,
+            progress_cb=progress_cb, progress_every=progress_every,
+            monitors=monitors,
+        )
     with x64_scope(config):
         return _run_batch(
             config, dataset, f_opt, seeds=seeds, sweep=sweep,
@@ -2311,6 +2363,126 @@ def run_batch(
             progress_cb=progress_cb, progress_every=progress_every,
             monitors=monitors,
         )
+
+
+def _run_sequential_mesh_batch(
+    config,
+    dataset: HostDataset,
+    f_opt: float,
+    *,
+    seeds,
+    sweep,
+    collect_metrics: bool,
+    measure_compile: bool,
+    state0,
+    t0: int,
+    executable_cache=None,
+    progress_cb=None,
+    progress_every: int = 1,
+    monitors=None,
+) -> BatchRunResult:
+    """R sequential worker-mesh runs presented as one ``BatchRunResult``.
+
+    Each replica r executes the IDENTICAL sharded program a direct
+    ``run(config.replace(replicas=1, seed=seeds[r], ...))`` would — same
+    halo exchange, same per-device bytes — so per-replica trajectories
+    are exactly the sequential ones (not merely equivalent). The topology
+    seed is pinned to the base config's resolved value so every replica
+    gossips over the SAME graph, matching the batched path's convention.
+    ``final_states`` leaves are host-fetched float64 ([R, ...] stacked);
+    batch continuation (``state0``/``t0``) is not supported here — the
+    sequential runs have no state-injection port yet.
+    """
+    from distributed_optimization_tpu.config import SWEEPABLE_FIELDS
+
+    if state0 is not None or t0 != 0:
+        raise ValueError(
+            "worker_mesh batches run as R sequential mesh runs, which "
+            "cannot resume from a stacked state0/t0 — continue each "
+            "replica with its own sequential run instead"
+        )
+    if seeds is None:
+        seeds = config.replica_seeds()
+    seeds = [int(s) for s in seeds]
+    if not seeds:
+        raise ValueError("run_batch needs at least one replica seed")
+    R = len(seeds)
+    sweep = {k: list(v) for k, v in (sweep or {}).items()}
+    for field, values in sweep.items():
+        if field not in SWEEPABLE_FIELDS:
+            raise ValueError(
+                f"cannot sweep {field!r} across a replica cohort: only "
+                f"the per-replica scalar axes ({', '.join(SWEEPABLE_FIELDS)}) "
+                "sweep this way; structural axes change the program — run "
+                "separate calls per value"
+            )
+        if len(values) != R:
+            raise ValueError(
+                f"sweep[{field!r}] has {len(values)} values for {R} "
+                "replicas; every swept axis must match the seed vector's "
+                "length"
+            )
+
+    topo_seed = config.resolved_topology_seed()
+    results = []
+    compile_seconds = 0.0
+    run_seconds = 0.0
+    for r in range(R):
+        overrides = {f: v[r] for f, v in sweep.items()}
+        rep_cfg = config.replace(
+            replicas=1, seed=seeds[r], topology_seed=topo_seed, **overrides
+        )
+        res = run(
+            rep_cfg, dataset, f_opt,
+            collect_metrics=collect_metrics,
+            measure_compile=measure_compile,
+            executable_cache=executable_cache,
+            progress_cb=progress_cb, progress_every=progress_every,
+            monitors=monitors, return_state=True,
+        )
+        compile_seconds += float(res.history.compile_seconds or 0.0)
+        ips = float(res.history.iters_per_second)
+        run_seconds += (
+            config.n_iterations / ips if ips > 0 else float("nan")
+        )
+        results.append(res)
+        if monitors is not None and monitors.halt_on != "never" and (
+            monitors.should_halt()
+        ):
+            break
+
+    objective = np.stack(
+        [np.asarray(res.history.objective, dtype=np.float64)
+         for res in results]
+    )
+    cons = (
+        np.stack([
+            np.asarray(res.history.consensus_error, dtype=np.float64)
+            for res in results
+        ])
+        if all(res.history.consensus_error is not None for res in results)
+        else None
+    )
+    final_states = {
+        k: np.stack([res.final_state[k] for res in results])
+        for k in results[0].final_state
+    }
+    done_R = len(results)
+    aggregate_ips = (
+        done_R * config.n_iterations / run_seconds
+        if run_seconds > 0 else float("nan")
+    )
+    return BatchRunResult(
+        results=results,
+        seeds=seeds[:done_R],
+        sweep=sweep or None,
+        objective=objective,
+        consensus_error=cons,
+        aggregate_iters_per_second=aggregate_ips,
+        run_seconds=run_seconds,
+        compile_seconds=compile_seconds,
+        final_states=final_states,
+    )
 
 
 def _run_batch(
@@ -2457,6 +2629,7 @@ def _run_batch(
             # are validated positive above, and each rep_cfg IS the
             # sequential run this batch must reproduce.
             impl=rep_cfgs[0].resolved_topology_impl(),
+            sampler=rep_cfgs[0].resolved_topology_sampler(),
         )
         mix_op = make_mixing_op(
             topo, impl=config.mixing_impl, dtype=device_data.X.dtype

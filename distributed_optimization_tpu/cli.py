@@ -349,6 +349,26 @@ def build_parser() -> argparse.ArgumentParser:
                             "CPU hosts simulate P devices via XLA_FLAGS="
                             "'--xla_force_host_platform_device_count=P'. "
                             "0 = unsharded")
+    execg.add_argument("--topology-sampler",
+                       choices=("auto", "dense", "sparse"),
+                       default=_DEFAULTS.topology_sampler,
+                       help="Erdős–Rényi graph sampler (docs/PERF.md §17): "
+                            "'dense' replays the [N, N] uniform stream "
+                            "bit-for-bit (O(N²) draws), 'sparse' draws "
+                            "O(N·k_max) — the million-worker path, a "
+                            "DIFFERENT realization of the same G(n, p) "
+                            "law (structural identity). 'auto' = dense "
+                            "below N=65,536 on the matrix-free ER path, "
+                            "sparse above")
+    execg.add_argument("--halo-overlap", choices=("off", "double_buffer"),
+                       default=_DEFAULTS.halo_overlap,
+                       help="worker-mesh halo-exchange overlap (docs/"
+                            "PERF.md §17): 'double_buffer' issues the "
+                            "boundary ppermutes first and computes the "
+                            "in-block partial sum while they are in "
+                            "flight (plain gossip mesh path only; "
+                            "reordered summation — not bitwise vs 'off'). "
+                            "'off' = PR 11's exchange, bitwise-pinned")
     execg.add_argument("--eval-every", type=int, default=_DEFAULTS.eval_every,
                        help="full-data objective eval cadence (1 = reference "
                             "parity)")
@@ -538,6 +558,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         replicas=args.replicas,
         tp_degree=args.tp,
         worker_mesh=args.worker_mesh,
+        topology_sampler=args.topology_sampler,
+        halo_overlap=args.halo_overlap,
         eval_every=args.eval_every,
         erdos_renyi_p=args.erdos_renyi_p,
         edge_drop_prob=args.edge_drop_prob,

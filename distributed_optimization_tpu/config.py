@@ -114,6 +114,15 @@ NEIGHBOR_TOPOLOGIES = ("ring", "grid", "chain", "erdos_renyi")
 # completes at N >= 10k.
 MATRIX_FREE_AUTO_N = 4096
 
+# N at which ``topology_sampler='auto'`` switches the matrix-free
+# Erdős–Rényi constructor to the O(N·k_max) sparse sampler. Below it the
+# O(N²)-draw dense-stream sampler stays the realization (it is the
+# bitwise reference the sparse sampler's law is tested against, and at
+# small N the quadratic draw cost is immaterial); above it the quadratic
+# stream replay is the recorded reason ER-at-100k was skipped in
+# docs/perf/worker_mesh.json, so 'auto' routes to sparse.
+SPARSE_SAMPLER_AUTO_N = 65_536
+
 # Per-replica scalar axes ``jax_backend.run_batch`` can sweep alongside the
 # seed axis (each replica r behaves exactly like a sequential run of
 # ``config.replace(seed=seeds[r], **{field: values[r]})``). Only scalars
@@ -412,6 +421,30 @@ class ExperimentConfig:
     # hosts simulate devices via
     # XLA_FLAGS=--xla_force_host_platform_device_count=P.
     worker_mesh: int = 0
+    # 'auto' | 'dense' | 'sparse'. Which Erdős–Rényi constructor realizes
+    # the matrix-free graph: 'dense' replays the [N, N] uniform stream
+    # bit-for-bit (O(N²) draws — the historical reference, and the oracle
+    # the sparse sampler is tested against below the cutoff); 'sparse'
+    # draws O(N·k_max) (forward-tail binomial degrees + tail-sampled
+    # partners — the million-node path). The two realize the SAME
+    # G(n, p) law but DIFFERENT graphs per (seed, p), so the resolved
+    # value is part of the structural identity (structural_dict). 'auto'
+    # picks 'sparse' above SPARSE_SAMPLER_AUTO_N on the matrix-free ER
+    # path, 'dense' otherwise. Only meaningful for topology='erdos_renyi'
+    # (rejected elsewhere rather than silently ignored).
+    topology_sampler: str = "auto"
+    # 'off' | 'double_buffer'. Halo-exchange overlap on the worker mesh
+    # (docs/PERF.md §17): 'off' runs PR 11's exchange unchanged
+    # (bitwise-pinned); 'double_buffer' issues the boundary-row ppermutes
+    # FIRST and computes the self + in-block partial sums while they are
+    # in flight (the standard stencil latency-hiding idiom — XLA's
+    # scheduler overlaps collectives with independent compute on
+    # accelerators; CPU single-stream may tie). The halo contributions
+    # are added after the in-block partial, a different summation order,
+    # so double_buffer is NOT bitwise vs off — it is a distinct
+    # structural program. Plain-gossip mesh path only (no compression,
+    # faults, or robust screening).
+    halo_overlap: str = "off"
 
     def __post_init__(self) -> None:
         if self.problem_type not in PROBLEM_TYPES:
@@ -848,25 +881,68 @@ class ExperimentConfig:
                     "record telemetry without a robust rule, or run the "
                     "robust study unsharded"
                 )
-            if self.compression != "none":
-                raise ValueError(
-                    "worker_mesh does not compose with compressed gossip: "
-                    "the error-feedback estimate exchange is measured on "
-                    "the unsharded path only — run compression studies "
-                    "with worker_mesh=0"
-                )
-            if self.replicas > 1:
-                raise ValueError(
-                    "worker_mesh and replicas > 1 are mutually exclusive: "
-                    "the replica axis vmaps one unsharded program (the "
-                    "replica axis fills the chip instead of the worker "
-                    "mesh) — run sharded seeds sequentially"
-                )
             if self.tp_degree > 1:
                 raise ValueError(
                     "worker_mesh and tp_degree > 1 are mutually "
                     "exclusive: the TP path pins its own 2-D (workers, "
                     "model) mesh"
+                )
+        if self.topology_sampler not in ("auto", "dense", "sparse"):
+            raise ValueError(
+                f"Unknown topology sampler: {self.topology_sampler!r} "
+                "(expected 'auto', 'dense', or 'sparse')"
+            )
+        if self.topology_sampler != "auto" and self.topology != "erdos_renyi":
+            raise ValueError(
+                f"topology_sampler={self.topology_sampler!r} selects the "
+                "matrix-free Erdős–Rényi constructor; topology="
+                f"{self.topology!r} has exactly one realization and would "
+                "silently ignore it — leave topology_sampler='auto'"
+            )
+        if (
+            self.topology_sampler == "sparse"
+            and self.topology_impl == "dense"
+        ):
+            raise ValueError(
+                "topology_sampler='sparse' only exists on the matrix-free "
+                "path: topology_impl='dense' replays the [N, N] uniform "
+                "stream as its own sampler — use topology_impl='auto' or "
+                "'neighbor'"
+            )
+        if self.halo_overlap not in ("off", "double_buffer"):
+            raise ValueError(
+                f"Unknown halo overlap mode: {self.halo_overlap!r} "
+                "(expected 'off' or 'double_buffer')"
+            )
+        if self.halo_overlap == "double_buffer":
+            if self.worker_mesh < 2:
+                raise ValueError(
+                    "halo_overlap='double_buffer' overlaps the worker-mesh "
+                    "halo exchange with local gather math; without "
+                    "worker_mesh >= 2 there is no exchange to overlap — "
+                    "leave halo_overlap='off'"
+                )
+            if self.compression != "none":
+                raise ValueError(
+                    "halo_overlap='double_buffer' does not compose with "
+                    "compressed gossip: the compressed exchange ships "
+                    "error-feedback estimate rows whose halo copies must "
+                    "land before the mix reads them — run overlap studies "
+                    "with compression='none'"
+                )
+            if (
+                self.straggler_prob > 0.0
+                or self.mttf > 0.0
+                or self.participation_rate < 1.0
+                or self.attack != "none"
+                or (self.aggregation != "gossip" and self.robust_b > 0)
+            ):
+                raise ValueError(
+                    "halo_overlap='double_buffer' restructures the PLAIN "
+                    "gossip mixing body only; the fault/robust mesh paths "
+                    "run their own liveness + model exchanges and would "
+                    "silently ignore it — run overlap studies on the "
+                    "plain path"
                 )
         if self.execution not in EXECUTIONS:
             raise ValueError(f"Unknown execution mode: {self.execution}")
@@ -1062,9 +1138,9 @@ class ExperimentConfig:
                     "stencils pin a fixed device mesh and the pallas "
                     "kernels address unbatched VMEM blocks — use 'auto', "
                     "'dense', 'stencil', 'sparse', or 'gather' (the "
-                    "sharded-gather worker_mesh path is likewise "
-                    "mesh-pinned and unbatchable; run sharded seeds "
-                    "sequentially)"
+                    "sharded-gather worker_mesh route instead dispatches "
+                    "replicas as sequential mesh runs — see "
+                    "jax_backend.run_batch)"
                 )
             if self.algorithm == "choco":
                 raise ValueError(
@@ -1213,6 +1289,30 @@ class ExperimentConfig:
             return "neighbor"
         return "dense"
 
+    def resolved_topology_sampler(self) -> str:
+        """Resolve topology_sampler='auto' (docs/PERF.md §17).
+
+        The sparse O(N·k_max) Erdős–Rényi sampler activates automatically
+        above ``SPARSE_SAMPLER_AUTO_N`` workers on the matrix-free ER
+        path — the regime where the dense sampler's O(N²) stream replay
+        is the recorded blocker. Below the cutoff (or off the matrix-free
+        ER path entirely) 'auto' keeps the dense-stream sampler: it is
+        the bitwise reference every pre-existing ER artifact realized,
+        and the graph IS the structural identity, so auto must never
+        silently re-realize small-N graphs. Non-ER topologies resolve to
+        'dense' (the only realization; __post_init__ rejects explicit
+        non-auto values for them).
+        """
+        if self.topology_sampler != "auto":
+            return self.topology_sampler
+        if (
+            self.topology == "erdos_renyi"
+            and self.resolved_topology_impl() == "neighbor"
+            and self.n_workers > SPARSE_SAMPLER_AUTO_N
+        ):
+            return "sparse"
+        return "dense"
+
     def structural_dict(self) -> dict[str, Any]:
         """The canonical view of everything that changes the TRACED program.
 
@@ -1261,6 +1361,17 @@ class ExperimentConfig:
             else None
         )
         d["topology_impl"] = self.resolved_topology_impl()
+        # The ER sampler realizes a DIFFERENT graph per identity (same
+        # law, different draws), and the realized graph is baked into the
+        # compiled program — so the RESOLVED sampler is structural, like
+        # topology_seed. Deterministic topologies have one realization
+        # and contribute None (a ring is the same program under any
+        # sampler name).
+        d["topology_sampler"] = (
+            self.resolved_topology_sampler()
+            if self.topology == "erdos_renyi"
+            else None
+        )
         d["edge_faults_traced"] = self.edge_drop_prob > 0.0
         d["clip_tau_fixed"] = self.clip_tau > 0.0
         return d

@@ -726,6 +726,7 @@ def fault_context(config, onset: int, *, window: Optional[int] = None,
             erdos_renyi_p=config.erdos_renyi_p,
             seed=config.resolved_topology_seed(),
             impl=config.resolved_topology_impl(),
+            sampler=config.resolved_topology_sampler(),
         )
         n_edges = max(len(_edge_list(topo)), 1)
         if hi * n_edges > max_cells:

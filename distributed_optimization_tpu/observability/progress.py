@@ -215,6 +215,7 @@ def make_live_bhat(config, max_cells: int = 200_000):
         erdos_renyi_p=config.erdos_renyi_p,
         seed=config.resolved_topology_seed(),
         impl=config.resolved_topology_impl(),
+        sampler=config.resolved_topology_sampler(),
     )
     n_edges = max(len(_edge_list(topo)), 1)
     if config.n_iterations * n_edges > max_cells:

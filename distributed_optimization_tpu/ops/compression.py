@@ -188,6 +188,28 @@ class ErrorFeedbackGossip:
         v_new = v + self.gamma * (mix(memory_new) - memory_new)
         return v_new, memory_new
 
+    def exchange_sharded(
+        self, key, v, memory, halo, compressed_mix: Callable
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """One compressed exchange over the worker mesh: ``(v⁺, x̂⁺, halo⁺)``.
+
+        ``compressed_mix(q, x̂⁺, halo) -> (W x̂⁺, halo⁺)`` is the sharded
+        wire form (``collectives.make_halo_compressed_mixing_op``): only
+        the increment q's boundary rows cross devices, and ``halo`` is the
+        persistent receiver-side copy of the neighbors' estimates that the
+        q rows scatter-ADD into — the receiver replays the owner's
+        ``x̂ ← x̂ + q`` update, which is what makes shipping q sufficient.
+        The local algebra (q, x̂⁺, the γ-step) is term-for-term
+        ``exchange``; the compressor runs OUTSIDE shard_map on the
+        row-sharded stack (row-wise + shape-based draws, so sharding
+        cannot change its output), keeping the historical per-row draws.
+        """
+        q = self.compressor.apply(key, v - memory)
+        memory_new = memory + q
+        mixed, halo_new = compressed_mix(q, memory_new, halo)
+        v_new = v + self.gamma * (mixed - memory_new)
+        return v_new, memory_new, halo_new
+
 
 def make_error_feedback(
     name: str, d: int, k: int, gamma: float

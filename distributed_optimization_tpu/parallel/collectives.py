@@ -271,15 +271,24 @@ class HaloExchange:
         )(self.nbr_l, self.mask, *self.sends, *self.recvs, *arrays)
 
 
-def make_halo_exchange(topo: Topology, mesh: Mesh) -> HaloExchange:
-    """Build the device-ready halo plan for a topology over a 1-D mesh."""
+def make_halo_exchange(
+    topo: Topology, mesh: Mesh, *, overlap: str = "off"
+) -> HaloExchange:
+    """Build the device-ready halo plan for a topology over a 1-D mesh.
+
+    ``overlap`` names the exchange form the plan serves (it is part of
+    the plan's memoization identity — see ``build_halo_plan``); the
+    device arrays are identical across modes today.
+    """
     n_devices = mesh.shape[WORKER_AXIS]
     nbr_idx, nbr_mask = neighbor_tables_for(topo)
     if topo.n % n_devices:
         raise ValueError(
             f"n_workers={topo.n} not divisible by mesh size {n_devices}"
         )
-    plan = build_halo_plan(nbr_idx, nbr_mask, n_devices)
+    plan = build_halo_plan(
+        nbr_idx, nbr_mask, n_devices, sampler=topo.sampler, overlap=overlap
+    )
     S, k_max = plan.shard_rows, nbr_idx.shape[1]
     return HaloExchange(
         mesh=mesh,
@@ -304,7 +313,9 @@ def make_halo_exchange(topo: Topology, mesh: Mesh) -> HaloExchange:
     )
 
 
-def make_halo_mixing_op(topo: Topology, mesh: Mesh, dtype=jnp.float32) -> MixingOp:
+def make_halo_mixing_op(
+    topo: Topology, mesh: Mesh, dtype=jnp.float32, *, overlap: str = "off"
+) -> MixingOp:
     """Sharded twin of ``ops/mixing.py`` impl='gather' over real collectives.
 
     MH weights are the identical per-slot values ``gather_mixing_weights``
@@ -314,13 +325,26 @@ def make_halo_mixing_op(topo: Topology, mesh: Mesh, dtype=jnp.float32) -> Mixing
     boundary rows arriving over ICI as ppermute traffic instead of being
     addressed in one device's HBM (the compiled-HLO payload test in
     tests/test_worker_mesh.py pins ring rounds to 2·d floats per device).
+
+    ``overlap='double_buffer'`` (config.halo_overlap; docs/PERF.md §17)
+    restructures ``apply`` into the stencil latency-hiding form: the
+    boundary-row ppermutes are issued FIRST, the self + in-block partial
+    sum computes while they are in flight (XLA schedules collectives
+    concurrently with independent compute on async backends), and the
+    halo contributions are added last. The summation ORDER differs from
+    the gather body (in-block slots before halo slots instead of slot
+    order), so double_buffer is a distinct structural program — NOT
+    bitwise vs off; 'off' is byte-for-byte the PR 11 body, which is the
+    gate tests/test_worker_mesh.py pins.
     """
     if topo.directed:
         raise ValueError(
             "halo gather mixing is undirected-only (MH weights per slot); "
             f"directed topology {topo.name!r} has no gather form"
         )
-    hx = make_halo_exchange(topo, mesh)
+    if overlap not in ("off", "double_buffer"):
+        raise ValueError(f"Unknown halo overlap mode: {overlap!r}")
+    hx = make_halo_exchange(topo, mesh, overlap=overlap)
     nbr_idx, nbr_mask = neighbor_tables_for(topo)
     w_nbr_np, w_self_np = gather_mixing_weights(
         nbr_idx, nbr_mask, topo.degrees
@@ -343,6 +367,52 @@ def make_halo_mixing_op(topo: Topology, mesh: Mesh, dtype=jnp.float32) -> Mixing
         x2 = x.reshape(x.shape[0], -1)
         return hx.run(body, w_nbr, w_self, x2).reshape(x.shape)
 
+    def apply_overlap(x: jax.Array) -> jax.Array:
+        S = hx.plan.shard_rows
+        h_max = hx.plan.h_max
+        n_steps = len(hx.perms)
+        perms = hx.perms
+        P_ = jax.sharding.PartitionSpec
+
+        def shard_body(nbr_lb, wn, ws, xb, *steps):
+            sends = steps[:n_steps]
+            recvs = steps[n_steps:]
+            nbr_l = nbr_lb[0]
+            # Issue every boundary-row send before touching the local
+            # math: the downstream partial sum has no data dependence on
+            # the permutes, so an async backend's scheduler runs the
+            # collectives concurrently with it (CPU single-stream ties).
+            got = [
+                jax.lax.ppermute(xb[s[0]], WORKER_AXIS, perm)
+                for perm, s in zip(perms, sends)
+            ]
+            in_block = nbr_l < S
+            wl = jnp.where(in_block, wn, jnp.zeros((), wn.dtype))
+            local = xb[jnp.where(in_block, nbr_l, 0)]
+            partial = ws[:, None] * xb + jnp.sum(
+                wl[:, :, None] * local, axis=1
+            )
+            halo = jnp.zeros((h_max + 1, xb.shape[-1]), xb.dtype)
+            for g, r in zip(got, recvs):
+                halo = halo.at[r[0]].set(g)
+            wh = jnp.where(in_block, jnp.zeros((), wn.dtype), wn)
+            hrows = halo[jnp.where(in_block, 0, nbr_l - S)]
+            out = partial + jnp.sum(wh[:, :, None] * hrows, axis=1)
+            return out.astype(xb.dtype)
+
+        x2 = x.reshape(x.shape[0], -1)
+        table_spec = P_(WORKER_AXIS, None, None)
+        step_spec = P_(WORKER_AXIS, None)
+        out = shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(table_spec, step_spec, P_(WORKER_AXIS),
+                      step_spec)
+            + tuple(step_spec for _ in range(2 * n_steps)),
+            out_specs=P_(WORKER_AXIS, None),
+        )(hx.nbr_l, w_nbr, w_self, x2, *hx.sends, *hx.recvs)
+        return out.reshape(x.shape)
+
     def neighbor_sum(x: jax.Array) -> jax.Array:
         def body(exchange, nbr_l, _mask_f32, mb, xb):
             out = jnp.sum(mb[:, :, None] * exchange(xb)[nbr_l], axis=1)
@@ -351,7 +421,102 @@ def make_halo_mixing_op(topo: Topology, mesh: Mesh, dtype=jnp.float32) -> Mixing
         x2 = x.reshape(x.shape[0], -1)
         return hx.run(body, mask_d, x2).reshape(x.shape)
 
-    return MixingOp(topo.name, "halo_gather", apply, neighbor_sum)
+    return MixingOp(
+        topo.name,
+        "halo_gather",
+        apply_overlap if overlap == "double_buffer" else apply,
+        neighbor_sum,
+    )
+
+
+def make_halo_compressed_mixing_op(topo: Topology, mesh: Mesh, dtype=jnp.float32):
+    """Compressed halo exchange: ship only the CHOCO increment's boundary rows.
+
+    Returns ``compressed_mix(q, xhat_new, halo) -> (mixed, halo_new)`` for
+    ``ops/compression.py::ErrorFeedbackGossip.exchange_sharded``: ``q`` is
+    the compressed increment (row-sharded [N, d]), ``xhat_new = x̂ + q`` the
+    already-updated local estimate, and ``halo`` the persistent receiver-side
+    copy of the NEIGHBORS' estimate rows ([P·(h_max+1), d] row-sharded —
+    h_max+1 rows per shard, the trailing one the dump row padded traffic
+    lands in). One round ppermutes only the boundary rows of ``q`` and
+    scatter-ADDS them into ``halo`` — the receiver replays the owner's
+    ``x̂ ← x̂ + q`` update on its copy, the wire form Koloskova et al. '19
+    rely on — then gathers the MH mix from the [block | halo] extension.
+
+    Starting from the all-zeros halo the backend seeds, the receiver copy
+    equals the owner row by induction (identical float adds on identical
+    values), so ``mixed`` is bitwise the gather-form mix of the exact
+    owner estimates. End-to-end sharded-vs-unsharded trajectories are
+    BITWISE equal for the deterministic compressors (top_k — pinned by
+    tests/test_worker_mesh.py); qsgd's stochastic rounding thresholds sit
+    on a row-norm reduction XLA may fuse differently across the two
+    compiled programs, so its parity gate is ~1e-12, not bitwise (the
+    same caveat every cross-program reduction in this repo carries). The
+    dump row is re-zeroed every round so padded-slot traffic (whose
+    scatter-add order XLA does not define when several padded sends land
+    together) can never leak into state.
+
+    Wire accounting: physically each ppermute still ships dense-width rows
+    (the analytic convention every comms number in this repo uses);
+    ``telemetry.ici_summary`` prices the rows at the compressor's
+    ``floats_per_edge`` — that is the committed byte cut in
+    docs/perf/mesh_scale.json.
+    """
+    if topo.directed:
+        raise ValueError(
+            "compressed halo mixing is undirected-only (MH weights per "
+            f"slot); directed topology {topo.name!r} has no gather form"
+        )
+    hx = make_halo_exchange(topo, mesh)
+    nbr_idx, nbr_mask = neighbor_tables_for(topo)
+    w_nbr_np, w_self_np = gather_mixing_weights(
+        nbr_idx, nbr_mask, topo.degrees
+    )
+    w_nbr = jnp.asarray(w_nbr_np, dtype=dtype)
+    w_self = jnp.asarray(w_self_np, dtype=dtype)
+    S = hx.plan.shard_rows
+    h_max = hx.plan.h_max
+    n_steps = len(hx.perms)
+    perms = hx.perms
+    halo_rows = mesh.shape[WORKER_AXIS] * (h_max + 1)
+
+    def compressed_mix(q: jax.Array, xhat_new: jax.Array, halo: jax.Array):
+        P_ = jax.sharding.PartitionSpec
+
+        def shard_body(nbr_lb, wn, ws, qb, xb, hb, *steps):
+            sends = steps[:n_steps]
+            recvs = steps[n_steps:]
+            nbr_l = nbr_lb[0]
+            hnew = hb
+            for perm, s, r in zip(perms, sends, recvs):
+                got = jax.lax.ppermute(qb[s[0]], WORKER_AXIS, perm)
+                hnew = hnew.at[r[0]].add(got)
+            # Padded steps all target the dump row; several adds landing
+            # there have no defined order — zero it so nothing leaks.
+            hnew = hnew.at[h_max].set(jnp.zeros((), hnew.dtype))
+            ext = jnp.concatenate([xb, hnew], axis=0)
+            out = ws[:, None] * xb + jnp.sum(
+                wn[:, :, None] * ext[nbr_l], axis=1
+            )
+            return out.astype(xb.dtype), hnew
+
+        q2 = q.reshape(q.shape[0], -1)
+        x2 = xhat_new.reshape(xhat_new.shape[0], -1)
+        h2 = halo.reshape(halo_rows, -1)
+        table_spec = P_(WORKER_AXIS, None, None)
+        step_spec = P_(WORKER_AXIS, None)
+        mixed, halo_new = shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(table_spec, step_spec, P_(WORKER_AXIS),
+                      step_spec, step_spec, step_spec)
+            + tuple(step_spec for _ in range(2 * n_steps)),
+            out_specs=(P_(WORKER_AXIS, None), P_(WORKER_AXIS, None)),
+        )(hx.nbr_l, w_nbr, w_self, q2, x2, h2, *hx.sends, *hx.recvs)
+        return mixed.reshape(xhat_new.shape), halo_new.reshape(halo.shape)
+
+    compressed_mix.halo_rows = halo_rows
+    return compressed_mix
 
 
 def make_halo_robust_aggregator_t(

@@ -42,6 +42,20 @@ matrix-free power iteration. The ER constructor consumes the numpy
 Generator stream row-by-row in exactly the order the dense sampler's one
 ``random((n, n))`` call does, so both representations of G(n, p, seed)
 realize the IDENTICAL graph.
+
+The million-worker round adds the SPARSE sampler
+(``build_neighbor_topology(..., sampler='sparse')``): the bit-identical
+ER constructor above replays the dense [N, N] uniform stream and is
+therefore O(N²) draws — the recorded reason ER-at-100k was skipped in
+docs/perf/worker_mesh.json. The sparse sampler draws O(N·k_max):
+per-node forward-degree Binomial(n−1−i, p) counts, tail-sampled
+partners, global dedupe + bounded top-up, and vectorized min-label
+connectivity — the SAME G(n, p) law, a DIFFERENT realization per
+(seed, p), so the sampler's identity is structural
+(``config.structural_dict()['topology_sampler']``). Ring/torus/chain
+tables are built by vectorized twins of the per-row list builders
+(bitwise-identical tables, pinned by tests) so a 1M-node mesh builds
+without any per-row Python loop or dense object.
 """
 
 from __future__ import annotations
@@ -99,6 +113,12 @@ class Topology:
     # Matrix-free neighbor table (None on the dense representation).
     nbr_idx: Optional[np.ndarray] = None   # [N, k_max] int32
     nbr_mask: Optional[np.ndarray] = None  # [N, k_max] bool
+    # Which random-graph sampler realized the table: 'dense' (the
+    # [N, N]-stream-replaying bitwise reference) or 'sparse' (the
+    # O(N·k_max)-draw constructor). Always 'dense' for deterministic
+    # topologies — the value is part of the graph's structural identity
+    # and keys the halo-plan cache (``build_halo_plan``).
+    sampler: str = "dense"
 
     @property
     def is_matrix_free(self) -> bool:
@@ -205,12 +225,18 @@ class Topology:
                 raise AssertionError(
                     f"degrees disagree with the neighbor mask ({self.name})"
                 )
-            edges = {
-                (int(i), int(j))
-                for i, row_mask in enumerate(mask)
-                for j in idx[i, row_mask]
-            }
-            if any((j, i) not in edges for i, j in edges):
+            # Symmetry as a vectorized multiset identity: the directed
+            # slot keys i·n + j must equal their swapped twins j·n + i
+            # after sorting — every (i → j) slot has a (j → i) twin.
+            # (O(E log E) numpy; the former per-edge Python set was the
+            # validation bottleneck at N = 1M.)
+            ii = np.broadcast_to(
+                np.arange(self.n, dtype=np.int64)[:, None], idx.shape
+            )[mask]
+            jj = idx[mask].astype(np.int64)
+            if not np.array_equal(
+                np.sort(ii * self.n + jj), np.sort(jj * self.n + ii)
+            ):
                 raise AssertionError(
                     f"neighbor table must be symmetric ({self.name})"
                 )
@@ -491,6 +517,162 @@ def _erdos_renyi_neighbor_lists(
     raise RuntimeError(f"Could not sample a connected G({n}, {p}) in 1000 tries")
 
 
+def _ring_neighbor_tables(n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized twin of ``_ring_neighbor_lists`` + ``_pad_neighbor_lists``
+    for n >= 3 (every node has the two distinct neighbors (i±1) mod n,
+    listed ascending) — bitwise-identical tables without the per-row
+    Python loop, the 1M-node path."""
+    ids = np.arange(n, dtype=np.int64)
+    left, right = (ids - 1) % n, (ids + 1) % n
+    nbr_idx = np.stack(
+        [np.minimum(left, right), np.maximum(left, right)], axis=1
+    ).astype(np.int32)
+    return nbr_idx, np.ones((n, 2), dtype=bool)
+
+
+def _chain_neighbor_tables(n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized twin of ``_chain_neighbor_lists`` + ``_pad_neighbor_lists``
+    for n >= 3 (interior rows [i−1, i+1]; endpoint rows degree 1 with the
+    padded slot self-pointing)."""
+    ids = np.arange(n, dtype=np.int32)
+    nbr_idx = np.tile(ids[:, None], (1, 2))
+    nbr_mask = np.zeros((n, 2), dtype=bool)
+    nbr_idx[1:-1, 0] = ids[1:-1] - 1
+    nbr_idx[1:-1, 1] = ids[1:-1] + 1
+    nbr_mask[1:-1] = True
+    nbr_idx[0, 0] = 1
+    nbr_mask[0, 0] = True
+    nbr_idx[-1, 0] = n - 2
+    nbr_mask[-1, 0] = True
+    return nbr_idx, nbr_mask
+
+
+def _torus_neighbor_tables(side: int) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized twin of ``_torus_neighbor_lists`` + ``_pad_neighbor_lists``
+    for square tori with side >= 3 (all four wrap neighbors distinct,
+    sorted ascending per row)."""
+    r = np.repeat(np.arange(side, dtype=np.int64), side)
+    c = np.tile(np.arange(side, dtype=np.int64), side)
+    stacked = np.stack(
+        [
+            ((r - 1) % side) * side + c,
+            ((r + 1) % side) * side + c,
+            r * side + (c - 1) % side,
+            r * side + (c + 1) % side,
+        ],
+        axis=1,
+    )
+    nbr_idx = np.sort(stacked, axis=1).astype(np.int32)
+    return nbr_idx, np.ones((side * side, 4), dtype=bool)
+
+
+def _pack_neighbor_tables(
+    src: np.ndarray, dst: np.ndarray, n: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pack forward undirected edges (src < dst, unique) into the padded
+    table — vectorized counterpart of ``_pad_neighbor_lists`` (padded
+    slots self-point, per-row neighbors ascending)."""
+    si = np.concatenate([src, dst])
+    di = np.concatenate([dst, src])
+    order = np.lexsort((di, si))
+    si, di = si[order], di[order]
+    deg = np.bincount(si, minlength=n)
+    k_max = max(int(deg.max()) if n else 0, 1)
+    nbr_idx = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, k_max))
+    nbr_mask = np.zeros((n, k_max), dtype=bool)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=offs[1:])
+    col = np.arange(si.size, dtype=np.int64) - offs[si]
+    nbr_idx[si, col] = di.astype(np.int32)
+    nbr_mask[si, col] = True
+    return nbr_idx, nbr_mask
+
+
+def _edges_connected(src: np.ndarray, dst: np.ndarray, n: int) -> bool:
+    """Connectivity of an undirected edge list by vectorized min-label
+    propagation with pointer jumping: each round every node takes the
+    minimum label over its closed neighborhood, then labels chase labels
+    (``lab[lab]``). At the fixed point labels are constant per component,
+    so connected ⟺ all labels equal node 0's. O((E + N) · rounds) with
+    rounds ~ log(diameter) — the union-find replacement that needs no
+    per-edge Python loop at N = 1M."""
+    if n == 0:
+        return False
+    lab = np.arange(n, dtype=np.int64)
+    for _ in range(10_000):
+        nxt = lab.copy()
+        np.minimum.at(nxt, src, lab[dst])
+        np.minimum.at(nxt, dst, lab[src])
+        nxt = nxt[nxt]
+        if np.array_equal(nxt, lab):
+            break
+        lab = nxt
+    return bool((lab == 0).all())
+
+
+# Bounded dedupe/top-up rounds for the sparse ER sampler. Each round
+# redraws only the deficit (forward edges lost to duplicate tail draws);
+# with k_max ≪ tail the per-draw collision probability is ~k_max/tail,
+# so deficits shrink geometrically and the bound is never approached in
+# practice — it exists so a pathological (n, p) fails loudly.
+_SPARSE_TOPUP_ROUNDS = 200
+
+
+def _erdos_renyi_forward_edges_sparse(
+    n: int, p: float, seed: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Connected G(n, p) in O(N·k_max) draws: the million-node sampler.
+
+    Decomposes the undirected upper-triangle draw by FORWARD tails: node
+    i's edges into {i+1, …, n−1} are Binomial(n−1−i, p) in number and
+    uniform without replacement in position. One vectorized
+    ``rng.binomial`` draws every forward degree, one vectorized uniform
+    draw proposes that many tail partners WITH replacement, and bounded
+    top-up rounds redraw exactly the rows that lost proposals to
+    duplicates — total work O(E) instead of the dense sampler's O(N²)
+    stream replay. Connectivity is vectorized min-label propagation
+    (``_edges_connected``); like every sampler here the generator stream
+    is seed-pure (draws depend only on (n, p, seed) and the retry
+    index), so a given seed realizes the same graph everywhere.
+
+    Same G(n, p) law as ``_erdos_renyi_neighbor_lists``, a DIFFERENT
+    realization per (seed, p) — which is why the sampler choice is part
+    of a config's structural identity rather than a transparent
+    implementation detail (``config.resolved_topology_sampler()``).
+
+    Returns the forward edge list ``(src, dst)`` with src < dst, unique,
+    for ``_pack_neighbor_tables``.
+    """
+    rng = np.random.default_rng(seed)
+    ids = np.arange(n, dtype=np.int64)
+    tail = (n - 1) - ids
+    for _ in range(1000):
+        counts = rng.binomial(tail, p)
+        src = np.repeat(ids, counts)
+        dst = src + 1 + np.floor(
+            rng.random(src.size) * tail[src]
+        ).astype(np.int64)
+        keys = np.unique(src * n + dst)
+        for _ in range(_SPARSE_TOPUP_ROUNDS):
+            deficit = counts - np.bincount(keys // n, minlength=n)
+            if not (deficit > 0).any():
+                break
+            src2 = np.repeat(ids, np.maximum(deficit, 0))
+            dst2 = src2 + 1 + np.floor(
+                rng.random(src2.size) * tail[src2]
+            ).astype(np.int64)
+            keys = np.unique(np.concatenate([keys, src2 * n + dst2]))
+        else:
+            raise RuntimeError(
+                f"sparse G({n}, {p}) top-up did not converge in "
+                f"{_SPARSE_TOPUP_ROUNDS} rounds"
+            )
+        src_f, dst_f = keys // n, keys % n
+        if _edges_connected(src_f, dst_f, n):
+            return src_f, dst_f
+    raise RuntimeError(f"Could not sample a connected G({n}, {p}) in 1000 tries")
+
+
 # Ceiling on the padded neighbor-table cell count (satellite guard): a
 # topology whose k_max approaches N has no degree-bounded structure to
 # exploit, and "matrix-free" would just reallocate the quadratic object
@@ -499,47 +681,11 @@ def _erdos_renyi_neighbor_lists(
 NEIGHBOR_TABLE_MAX_CELLS = 64_000_000
 
 
-def build_neighbor_topology(
-    name: str,
-    n: int,
-    *,
-    erdos_renyi_p: float = 0.4,
-    seed: int = 0,
-) -> Topology:
-    """Matrix-free constructor: the [N, k_max] neighbor table IS the graph.
-
-    Supports ``MATRIX_FREE_TOPOLOGIES`` (undirected, degree-bounded).
-    fully_connected and star are rejected loudly — k_max = N−1 makes the
-    padded table the very [N, N] allocation this path exists to avoid —
-    and any draw whose table would exceed ``NEIGHBOR_TABLE_MAX_CELLS``
-    (or whose k_max reaches N−1) routes the caller back to dense with the
-    reason.
-    """
-    if name in ("fully_connected", "star"):
-        raise ValueError(
-            f"topology {name!r} has k_max = N-1: its neighbor table IS the "
-            "dense [N, N] object the matrix-free path avoids — use the "
-            "dense representation (impl='dense')"
-        )
-    grid_shape: Optional[tuple[int, int]] = None
-    if name == "ring":
-        nbrs = _ring_neighbor_lists(n)
-    elif name == "chain":
-        nbrs = _chain_neighbor_lists(n)
-    elif name == "grid":
-        side = int(math.isqrt(n))
-        if side * side != n:
-            raise ValueError(f"grid topology requires a perfect square, got {n}")
-        nbrs = _torus_neighbor_lists(side, side)
-        grid_shape = (side, side)
-    elif name == "erdos_renyi":
-        nbrs = _erdos_renyi_neighbor_lists(n, erdos_renyi_p, seed)
-    else:
-        raise ValueError(
-            f"no matrix-free constructor for topology {name!r} "
-            f"(supported: {MATRIX_FREE_TOPOLOGIES})"
-        )
-    k_max = max((len(v) for v in nbrs), default=0)
+def _guard_table_size(k_max: int, n: int) -> None:
+    """The two degree guards of the matrix-free path, shared by every
+    constructor branch: a k_max approaching N has no degree bound to
+    exploit, and the padded table's cell count is capped so 'matrix-free'
+    can never silently reallocate the quadratic object."""
     if n > 2 and k_max >= n - 1:
         raise ValueError(
             f"realized max degree {k_max} at N={n} leaves no degree bound "
@@ -554,7 +700,92 @@ def build_neighbor_topology(
             "for the degree-bounded path; use the dense representation "
             "or a sparser graph"
         )
-    nbr_idx, nbr_mask = _pad_neighbor_lists(nbrs, n)
+
+
+def build_neighbor_topology(
+    name: str,
+    n: int,
+    *,
+    erdos_renyi_p: float = 0.4,
+    seed: int = 0,
+    sampler: str = "dense",
+) -> Topology:
+    """Matrix-free constructor: the [N, k_max] neighbor table IS the graph.
+
+    Supports ``MATRIX_FREE_TOPOLOGIES`` (undirected, degree-bounded).
+    fully_connected and star are rejected loudly — k_max = N−1 makes the
+    padded table the very [N, N] allocation this path exists to avoid —
+    and any draw whose table would exceed ``NEIGHBOR_TABLE_MAX_CELLS``
+    (or whose k_max reaches N−1) routes the caller back to dense with the
+    reason.
+
+    ``sampler`` selects the Erdős–Rényi constructor: 'dense' replays the
+    [N, N] uniform stream bit-for-bit (O(N²) draws — the historical
+    reference), 'sparse' draws O(N·k_max)
+    (``_erdos_renyi_forward_edges_sparse`` — the million-node path, a
+    different realization of the same law). Deterministic topologies
+    ignore it (their tables are unique); callers resolve 'auto' policy
+    via ``config.resolved_topology_sampler()`` before calling.
+    """
+    if name in ("fully_connected", "star"):
+        raise ValueError(
+            f"topology {name!r} has k_max = N-1: its neighbor table IS the "
+            "dense [N, N] object the matrix-free path avoids — use the "
+            "dense representation (impl='dense')"
+        )
+    if sampler not in ("dense", "sparse"):
+        raise ValueError(
+            f"unknown topology sampler {sampler!r} (expected 'dense' or "
+            "'sparse')"
+        )
+    grid_shape: Optional[tuple[int, int]] = None
+    sampler_used = "dense"
+    if name == "ring":
+        tables = (
+            _ring_neighbor_tables(n)
+            if n > 2
+            else _pad_neighbor_lists(_ring_neighbor_lists(n), n)
+        )
+    elif name == "chain":
+        tables = (
+            _chain_neighbor_tables(n)
+            if n > 2
+            else _pad_neighbor_lists(_chain_neighbor_lists(n), n)
+        )
+    elif name == "grid":
+        side = int(math.isqrt(n))
+        if side * side != n:
+            raise ValueError(f"grid topology requires a perfect square, got {n}")
+        tables = (
+            _torus_neighbor_tables(side)
+            if side >= 3
+            else _pad_neighbor_lists(_torus_neighbor_lists(side, side), n)
+        )
+        grid_shape = (side, side)
+    elif name == "erdos_renyi":
+        sampler_used = sampler
+        if sampler == "sparse":
+            src, dst = _erdos_renyi_forward_edges_sparse(
+                n, erdos_renyi_p, seed
+            )
+            # Guard on the realized degrees BEFORE allocating the padded
+            # table — at this scale the table is the dominant allocation.
+            deg = np.bincount(
+                np.concatenate([src, dst]), minlength=max(n, 1)
+            )
+            _guard_table_size(int(deg.max()) if n else 0, n)
+            tables = _pack_neighbor_tables(src, dst, n)
+        else:
+            nbrs = _erdos_renyi_neighbor_lists(n, erdos_renyi_p, seed)
+            _guard_table_size(max((len(v) for v in nbrs), default=0), n)
+            tables = _pad_neighbor_lists(nbrs, n)
+    else:
+        raise ValueError(
+            f"no matrix-free constructor for topology {name!r} "
+            f"(supported: {MATRIX_FREE_TOPOLOGIES})"
+        )
+    nbr_idx, nbr_mask = tables
+    _guard_table_size(int(nbr_mask.sum(axis=1).max()) if n else 0, n)
     topo = Topology(
         name=name,
         n=n,
@@ -564,6 +795,7 @@ def build_neighbor_topology(
         grid_shape=grid_shape,
         nbr_idx=nbr_idx,
         nbr_mask=nbr_mask,
+        sampler=sampler_used,
     )
     topo.validate()
     return topo
@@ -638,7 +870,12 @@ _HALO_PLAN_CACHE_MAX = 8
 
 
 def build_halo_plan(
-    nbr_idx: np.ndarray, nbr_mask: np.ndarray, n_shards: int
+    nbr_idx: np.ndarray,
+    nbr_mask: np.ndarray,
+    n_shards: int,
+    *,
+    sampler: str = "dense",
+    overlap: str = "off",
 ) -> HaloPlan:
     """Shard a padded neighbor table into P contiguous row blocks + halo maps.
 
@@ -652,6 +889,12 @@ def build_halo_plan(
     the sender's packing and the receiver's halo positions agree by
     construction (asserted against the realized adjacency in
     tests/test_worker_mesh.py).
+
+    ``sampler`` and ``overlap`` name the exchange form the plan serves
+    (the topology's sampler identity and the ``halo_overlap`` mode).
+    Today's plan layout is identical across both, but they are part of
+    the memoization key so a cache hit can never serve a plan built for
+    the other exchange form if the layouts ever diverge.
     """
     n, k_max = nbr_idx.shape
     if n_shards < 2:
@@ -663,7 +906,10 @@ def build_halo_plan(
     digest = hashlib.sha1()
     digest.update(np.ascontiguousarray(nbr_idx).tobytes())
     digest.update(np.ascontiguousarray(nbr_mask).tobytes())
-    cache_key = (digest.hexdigest(), nbr_idx.shape, int(n_shards))
+    cache_key = (
+        digest.hexdigest(), nbr_idx.shape, int(n_shards),
+        str(sampler), str(overlap),
+    )
     cached = _HALO_PLAN_CACHE.get(cache_key)
     if cached is not None:
         _HALO_PLAN_CACHE.move_to_end(cache_key)
@@ -797,6 +1043,7 @@ def build_topology(
     erdos_renyi_p: float = 0.4,
     seed: int = 0,
     impl: str = "dense",
+    sampler: str = "dense",
 ) -> Topology:
     """Build a named topology over ``n`` workers.
 
@@ -809,13 +1056,25 @@ def build_topology(
     padded neighbor table instead (``build_neighbor_topology`` — the
     federated-scale route, docs/PERF.md §14). Callers resolve 'auto'
     policy via ``config.resolved_topology_impl()`` before calling.
+
+    ``sampler`` (matrix-free Erdős–Rényi only) picks the 'dense'
+    bitwise-reference or 'sparse' O(N·k_max) constructor; callers resolve
+    'auto' via ``config.resolved_topology_sampler()``. The dense [N, N]
+    representation has exactly one sampler — requesting 'sparse' with
+    ``impl='dense'`` is a contradiction and raises.
     """
     if impl == "neighbor":
         return build_neighbor_topology(
-            name, n, erdos_renyi_p=erdos_renyi_p, seed=seed
+            name, n, erdos_renyi_p=erdos_renyi_p, seed=seed, sampler=sampler
         )
     if impl != "dense":
         raise ValueError(f"Unknown topology impl: {impl!r}")
+    if sampler != "dense":
+        raise ValueError(
+            "the dense [N, N] representation replays its own uniform "
+            f"stream — sampler={sampler!r} only exists on the matrix-free "
+            "path (impl='neighbor')"
+        )
     if name in ("directed_ring", "directed_erdos_renyi"):
         adj = (
             _directed_ring_adjacency(n)

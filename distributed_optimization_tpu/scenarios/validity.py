@@ -539,16 +539,14 @@ RULES: tuple[Rule, ...] = (
            "worker_mesh does not yet compose with the telemetry "
            "robust-activity probe"
        )),
-    _r("mesh×compression", ("worker_mesh", "compression"),
-       lambda f: _mesh_base_ok(f) and f["compression"] != "none",
-       lambda f: (
-           "worker_mesh does not compose with compressed gossip"
-       )),
-    _r("mesh×replicas", ("worker_mesh", "replicas"),
-       lambda f: _mesh_base_ok(f) and f["replicas"] > 1,
-       lambda f: (
-           "worker_mesh and replicas > 1 are mutually exclusive"
-       )),
+    # mesh×compression and mesh×replicas deleted (ISSUE-18): compressed
+    # gossip runs the halo-compressed exchange (only boundary rows of the
+    # error-feedback increment cross the wire — collectives.
+    # make_halo_compressed_mixing_op), and a worker_mesh run with
+    # replicas=R dispatches R sequential mesh runs through run_batch's
+    # sequential-mesh path. The mesh+replicas+compression triple stays
+    # rejected via the surviving replicas×compression/replicas×choco
+    # rules below.
     _r("mesh×tp", ("worker_mesh",),
        lambda f: _mesh_base_ok(f) and f["tp_degree"] > 1,
        lambda f: (

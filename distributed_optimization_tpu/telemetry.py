@@ -364,6 +364,7 @@ def _config_topology(config):
         erdos_renyi_p=config.erdos_renyi_p,
         seed=config.resolved_topology_seed(),
         impl=config.resolved_topology_impl(),
+        sampler=config.resolved_topology_sampler(),
     )
 
 
@@ -705,7 +706,10 @@ def ici_summary(
     if topo is None:
         topo = _config_topology(config)
     nbr_idx, nbr_mask = neighbor_tables_for(topo)
-    plan = build_halo_plan(nbr_idx, nbr_mask, config.worker_mesh)
+    plan = build_halo_plan(
+        nbr_idx, nbr_mask, config.worker_mesh,
+        sampler=topo.sampler, overlap=config.halo_overlap,
+    )
     problem = get_problem(
         config.problem_type, huber_delta=config.huber_delta,
         n_classes=config.n_classes,
@@ -733,7 +737,16 @@ def ici_summary(
         avail, deg_col = 1, 1  # availability bit + realized-degree column
     else:
         avail = deg_col = 0
-    floats_per_row = (d_model + deg_col + avail) * algo.gossip_rounds
+    if config.compression != "none":
+        from distributed_optimization_tpu.ops.compression import (
+            make_compressor,
+        )
+
+        floats_per_row = make_compressor(
+            config.compression, d_model, config.compression_k
+        ).floats_per_edge * algo.gossip_rounds
+    else:
+        floats_per_row = (d_model + deg_col + avail) * algo.gossip_rounds
     itemsize = int(np.dtype(config.dtype).itemsize)
     # Per-row bytes of each exchange FORM the compiled round can run.
     # The availability bit ships as its OWN f32 halo exchange (fault
@@ -745,6 +758,21 @@ def ici_summary(
     acc_size = max(itemsize, 4)
     if node_faults:
         base_row = 4 + (d_model + 1) * acc_size  # avail + model+degree
+    elif config.compression != "none":
+        # Compressed halo exchange (ISSUE-18): the wire rows carry the
+        # compressor's payload instead of the dense d_model row — the
+        # analytic accounting convention every comms number in this repo
+        # uses (top_k/random_k: k values + k indices; qsgd: packed bits +
+        # the norm). Compression composes only with the plain benign mesh
+        # (config rejects it with faults/robust/attack), so this branch
+        # never interacts with the side-channel pricing above.
+        from distributed_optimization_tpu.ops.compression import (
+            make_compressor,
+        )
+
+        base_row = make_compressor(
+            config.compression, d_model, config.compression_k
+        ).floats_per_edge * itemsize
     else:
         base_row = d_model * itemsize            # plain halo mix
     robust_row = 4 + (d_model + deg_col) * acc_size
@@ -783,7 +811,11 @@ def ici_summary(
         "bytes_per_device_per_round": [wire_rows * row_bytes] * n_dev,
         "bytes_per_device_per_round_max": wire_rows * row_bytes,
         "bytes_total_per_round": n_dev * wire_rows * row_bytes,
-        "payload_floats_per_row": int(floats_per_row),
+        "payload_floats_per_row": (
+            float(floats_per_row) if config.compression != "none"
+            else int(floats_per_row)
+        ),
+        "compression": config.compression,
         "itemsize": itemsize,
     }
 

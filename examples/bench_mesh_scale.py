@@ -1,0 +1,452 @@
+"""Million-worker mesh evidence (ISSUE 18) -> docs/perf/mesh_scale.json.
+
+Runs under a FORCED 16-device host platform (XLA_FLAGS, set below before
+jax initializes). Four measured claims, each gated:
+
+1. **1M completion** — N = 1,000,000 ring AND torus runs COMPLETE
+   sharded over 16 devices (10× the N=100k headroom worker_mesh.json
+   recorded), with per-device resident bytes probed from live array
+   shards mid-run. The 250k/P=4 cell pairs with 1M/P=16 at identical
+   rows/device (62,500), so the sharded per-device footprint must come
+   out flat — the O(N/P) memory law at the million scale.
+2. **Sparse ER at 1M** — the O(N·k_max) sampler builds a connected
+   G(10^6, 20/10^6) neighbor table + 16-shard halo plan in seconds
+   (build time recorded), where the dense-stream sampler's O(N²) replay
+   is ~hours. The optimizer run is NOT claimed at this cell: a uniform
+   random graph sharded 16 ways has no block locality — nearly every
+   neighbor is remote, so the halo degenerates toward a full gather and
+   the honest run evidence stays at worker_mesh.json's ER cells (10k
+   dense-sampled, 100k sparse-sampled).
+3. **Compressed halo cut** — top_k (2k = 8 floats/row) prices ≤ 50% of
+   the uncompressed halo bytes on the wire (telemetry.ici_summary over
+   the same static plan that drives the collectives), and the compressed
+   run's final gap stays within the 2.5× envelope of the uncompressed
+   run at equal iterations (the fused_robust.json convention).
+4. **Overlap** — halo_overlap='double_buffer' is measured against 'off'
+   at matched config. On this single-stream CPU host the ppermute/
+   compute overlap has no hardware to exploit, so the ratio is reported
+   with an honest ``overlap_loses`` flag rather than asserted >= 1; the
+   load-bearing gate is bitwise-off parity (tests/test_mesh_scale.py).
+
+CPU-container numbers: absolute iters/sec is not chip evidence; the
+load-bearing content is the completions, the flat footprint, the wire
+accounting, and the honest flags.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+# Must precede any jax import, including in spawn-context subprocesses
+# (they re-import this module's top level).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=16"
+    ).strip()
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+OUT = REPO / "docs" / "perf" / "mesh_scale.json"
+
+SCALE_T = 10
+# (label, topology, n, worker_mesh) — one subprocess per cell, 1 sample
+# per worker (the model state, not the data, is the 1M-scale object).
+# 250k/P=4 pairs with 1M/P=16: 62,500 rows/device each, so sharded
+# per-device bytes must be flat.
+SCALE_CELLS = (
+    ("ring_250k_p4", "ring", 250_000, 4),
+    ("ring_1m_p16", "ring", 1_000_000, 16),
+    ("torus_1m_p16", "grid", 1_000_000, 16),
+)
+
+ER_N = 1_000_000
+ER_MEAN_DEGREE = 20.0  # above the ln(N) ≈ 13.8 connectivity threshold
+
+COMPRESS_N = 4096
+COMPRESS_T = 400
+
+OVERLAP_N = 100_000
+OVERLAP_T = 30
+
+
+def _mesh_cfg(topology, n, mesh_p, **extra):
+    from distributed_optimization_tpu.config import ExperimentConfig
+
+    return ExperimentConfig(
+        n_workers=n, n_samples=n, n_features=16, n_informative_features=10,
+        problem_type="quadratic", topology=topology, algorithm="dsgd",
+        local_batch_size=1, n_iterations=SCALE_T, eval_every=SCALE_T,
+        topology_impl="neighbor", mixing_impl="gather",
+        worker_mesh=mesh_p, **extra,
+    )
+
+
+def _scale_cell(args):
+    """One sharded scale cell in a fresh subprocess (honest peak RSS +
+    per-device resident bytes probed at the first progress heartbeat)."""
+    label, topology, n, mesh_p = args
+    import collections
+    import resource
+    import time
+
+    import jax
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.telemetry import ici_summary
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+
+    cfg = _mesh_cfg(topology, n, mesh_p)
+    t0 = time.perf_counter()
+    ds = generate_synthetic_dataset(cfg)
+    data_seconds = time.perf_counter() - t0
+
+    per_device: dict[str, int] = {}
+
+    def probe(_event):
+        # Live per-device resident bytes mid-run: every live jax array's
+        # realized shard sizes, summed per device. Device 0 additionally
+        # holds the replicated leaves (keys, scalars); devices outside
+        # the P-device mesh hold nothing and never appear.
+        if per_device:
+            return
+        acc = collections.Counter()
+        for a in jax.live_arrays():
+            for s in a.addressable_shards:
+                acc[str(s.device)] += s.data.nbytes
+        per_device.update(acc)
+
+    t0 = time.perf_counter()
+    r = jax_backend.run(cfg, ds, 0.0, progress_cb=probe, progress_every=1)
+    wall = time.perf_counter() - t0
+    gap = float(r.history.objective[-1])
+    assert gap == gap, f"{label}: NaN gap"
+    return {
+        "label": label,
+        "topology": topology,
+        "n_workers": n,
+        "worker_mesh": mesh_p,
+        "rows_per_device": n // mesh_p,
+        "iters_per_second": float(r.history.iters_per_second),
+        "compile_seconds": float(r.history.compile_seconds),
+        "wall_seconds": wall,
+        "data_seconds": data_seconds,
+        "final_gap": gap,
+        "peak_rss_mb": resource.getrusage(
+            resource.RUSAGE_SELF
+        ).ru_maxrss / 1024.0,
+        "sharded_bytes_per_device": (
+            min(per_device.values()) if per_device else None
+        ),
+        "ici": ici_summary(cfg),
+    }
+
+
+def _er_plan_cell(_):
+    """Sparse-sampler build + halo-plan cell (no optimizer run — see
+    module docstring): the O(N·k_max) claim measured at N=10^6."""
+    import resource
+    import time
+
+    import numpy as np
+
+    from distributed_optimization_tpu.parallel.topology import (
+        build_halo_plan,
+        build_neighbor_topology,
+        neighbor_tables_for,
+    )
+
+    p = ER_MEAN_DEGREE / ER_N
+    t0 = time.perf_counter()
+    topo = build_neighbor_topology(
+        "erdos_renyi", ER_N, erdos_renyi_p=p, seed=3, sampler="sparse"
+    )
+    build_seconds = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    plan = build_halo_plan(*neighbor_tables_for(topo), 16, sampler="sparse")
+    plan_seconds = time.perf_counter() - t0
+    assert topo.sampler == "sparse"
+    return {
+        "n_workers": ER_N,
+        "erdos_renyi_p": p,
+        "sampler": "sparse",
+        "build_seconds": build_seconds,
+        "plan_seconds": plan_seconds,
+        "k_max": int(topo.nbr_idx.shape[1]),
+        "mean_degree": float(topo.degrees.mean()),
+        "table_mb": float(
+            (topo.nbr_idx.nbytes + topo.nbr_mask.nbytes) / 1e6
+        ),
+        "halo_rows_per_device_max": int(
+            max(len(h) for h in plan.halo_idx)
+        ),
+        "wire_rows_per_device": int(
+            np.sum([st.send_idx.shape[1] for st in plan.steps])
+        ),
+        "peak_rss_mb": resource.getrusage(
+            resource.RUSAGE_SELF
+        ).ru_maxrss / 1024.0,
+        "run_skipped": (
+            "a uniform random graph sharded 16 ways has no block "
+            "locality — nearly every neighbor is remote, the halo "
+            "degenerates toward a full gather; run evidence for ER stays "
+            "at worker_mesh.json (N=10k dense-sampled, N=100k "
+            "sparse-sampled), this cell carries the O(N·k_max) build"
+        ),
+    }
+
+
+def bench_compression():
+    import numpy as np
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.config import ExperimentConfig
+    from distributed_optimization_tpu.telemetry import ici_summary
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+    from distributed_optimization_tpu.utils.oracle import (
+        compute_reference_optimum,
+    )
+
+    base = dict(
+        n_workers=COMPRESS_N, n_samples=4 * COMPRESS_N, n_features=16,
+        n_informative_features=10, problem_type="quadratic",
+        topology="ring", algorithm="dsgd", local_batch_size=8,
+        dtype="float64", n_iterations=COMPRESS_T,
+        eval_every=COMPRESS_T // 4, topology_impl="neighbor",
+        mixing_impl="gather", worker_mesh=4,
+    )
+    cfg_plain = ExperimentConfig(**base)
+    cfg_topk = ExperimentConfig(**{
+        **base, "compression": "top_k", "compression_k": 4,
+        "choco_gamma": 0.5,
+    })
+    ds = generate_synthetic_dataset(cfg_plain)
+    _, f_opt = compute_reference_optimum(ds, cfg_plain.reg_param)
+    r_plain = jax_backend.run(cfg_plain, ds, f_opt)
+    r_topk = jax_backend.run(cfg_topk, ds, f_opt)
+    gap_plain = float(r_plain.history.objective[-1])
+    gap_topk = float(r_topk.history.objective[-1])
+    ici_plain = ici_summary(cfg_plain)
+    ici_topk = ici_summary(cfg_topk)
+    bytes_ratio = (
+        ici_topk["bytes_per_device_per_round_max"]
+        / ici_plain["bytes_per_device_per_round_max"]
+    )
+    gap_ratio = gap_topk / gap_plain
+    assert bytes_ratio <= 0.5, bytes_ratio
+    assert gap_ratio <= 2.5, gap_ratio
+    print(f"[compress] wire bytes ratio {bytes_ratio:.3f}, "
+          f"gap ratio {gap_ratio:.3f}")
+    return {
+        "n_workers": COMPRESS_N,
+        "n_iterations": COMPRESS_T,
+        "worker_mesh": 4,
+        "compression": "top_k",
+        "compression_k": 4,
+        "floats_per_row_plain": ici_plain["payload_floats_per_row"],
+        "floats_per_row_topk": ici_topk["payload_floats_per_row"],
+        "bytes_per_device_per_round_plain": ici_plain[
+            "bytes_per_device_per_round_max"],
+        "bytes_per_device_per_round_topk": ici_topk[
+            "bytes_per_device_per_round_max"],
+        "wire_bytes_ratio": bytes_ratio,
+        "final_gap_plain": gap_plain,
+        "final_gap_topk": gap_topk,
+        "gap_ratio": gap_ratio,
+        "models_match_unsharded": bool(np.array_equal(
+            np.asarray(r_topk.final_models),
+            np.asarray(jax_backend.run(
+                cfg_topk.replace(worker_mesh=0), ds, f_opt, use_mesh=False
+            ).final_models),
+        )),
+    }
+
+
+def bench_overlap():
+    import time
+
+    from distributed_optimization_tpu.backends import jax_backend
+    from distributed_optimization_tpu.utils.data import (
+        generate_synthetic_dataset,
+    )
+
+    cfg_off = _mesh_cfg("ring", OVERLAP_N, 4).replace(
+        n_iterations=OVERLAP_T, eval_every=OVERLAP_T
+    )
+    cfg_db = cfg_off.replace(halo_overlap="double_buffer")
+    ds = generate_synthetic_dataset(cfg_off)
+    cells = {}
+    for label, cfg in (("off", cfg_off), ("double_buffer", cfg_db)):
+        t0 = time.perf_counter()
+        r = jax_backend.run(cfg, ds, 0.0)
+        cells[label] = {
+            "iters_per_second": float(r.history.iters_per_second),
+            "compile_seconds": float(r.history.compile_seconds),
+            "wall_seconds": time.perf_counter() - t0,
+            "final_gap": float(r.history.objective[-1]),
+        }
+        print(f"[overlap] {label}: "
+              f"{cells[label]['iters_per_second']:.1f} iters/s")
+    ratio = (cells["double_buffer"]["iters_per_second"]
+             / cells["off"]["iters_per_second"])
+    return {
+        "n_workers": OVERLAP_N,
+        "n_iterations": OVERLAP_T,
+        "worker_mesh": 4,
+        "cells": cells,
+        "double_buffer_speedup": ratio,
+        "overlap_loses": bool(ratio < 1.0),
+        "note": (
+            "single-stream CPU host: ppermute and the in-block partial "
+            "sum serialize, so the restructured body can only tie or "
+            "lose here — the flag is reported honestly, not asserted; "
+            "the accelerator rationale is the issued-first ppermute the "
+            "double_buffer body hands XLA's latency-hiding scheduler"
+        ),
+    }
+
+
+def main() -> None:
+    import multiprocessing as mp
+    from concurrent import futures
+
+    import jax
+
+    from distributed_optimization_tpu.telemetry import write_bench_manifest
+    from distributed_optimization_tpu.utils.profiling import PhaseTimer
+
+    assert len(jax.devices()) >= 16, (
+        "mesh-scale bench needs the forced 16-device host platform; do "
+        "not pre-set XLA_FLAGS without xla_force_host_platform_device_count"
+    )
+    timer = PhaseTimer()
+    ctx = mp.get_context("spawn")
+    cells = []
+    with timer.phase("scale"):
+        for job in SCALE_CELLS:  # sequential: no interference
+            with futures.ProcessPoolExecutor(1, mp_context=ctx) as pool:
+                cell = pool.submit(_scale_cell, job).result()
+            cells.append(cell)
+            print(f"[scale] {cell['label']}: "
+                  f"{cell['iters_per_second']:.1f} iters/s, "
+                  f"{cell['sharded_bytes_per_device'] / 1e6:.1f} MB/device, "
+                  f"peak RSS {cell['peak_rss_mb']:.0f} MB")
+    with timer.phase("er_plan"):
+        with futures.ProcessPoolExecutor(1, mp_context=ctx) as pool:
+            er_plan = pool.submit(_er_plan_cell, None).result()
+        print(f"[er] build {er_plan['build_seconds']:.1f}s, "
+              f"k_max {er_plan['k_max']}, "
+              f"plan {er_plan['plan_seconds']:.1f}s")
+    with timer.phase("compression"):
+        compression = bench_compression()
+    with timer.phase("overlap"):
+        overlap = bench_overlap()
+
+    by_label = {c["label"]: c for c in cells}
+    big = by_label["ring_1m_p16"]
+    pair_ratio = (
+        big["sharded_bytes_per_device"]
+        / by_label["ring_250k_p4"]["sharded_bytes_per_device"]
+    )
+    assert 0.8 <= pair_ratio <= 1.25, pair_ratio
+    assert (big["ici"]["bytes_per_device_per_round_max"]
+            == by_label["ring_250k_p4"]["ici"][
+                "bytes_per_device_per_round_max"])
+    assert compression["models_match_unsharded"]
+
+    payload = {
+        "device": jax.devices()[0].device_kind,
+        "platform": jax.devices()[0].platform,
+        "protocol": {
+            "devices": (
+                "forced 16-device CPU host platform (XLA_FLAGS), real "
+                "shard_map/ppermute collectives"
+            ),
+            "scale": (
+                "ring 250k/P=4 + ring 1M/P=16 + torus 1M/P=16, dsgd "
+                f"T={SCALE_T}, 1 sample/worker, one subprocess per cell; "
+                "per-device resident bytes probed from live array shards "
+                "at the first progress heartbeat; the 250k/P=4 and "
+                "1M/P=16 cells hold rows/device fixed at 62,500"
+            ),
+            "er": (
+                "O(N·k_max) sparse sampler at N=10^6, mean degree "
+                f"{ER_MEAN_DEGREE:.0f} (> ln N), seed-pure; build + "
+                "16-shard halo plan timed, run honestly skipped (see "
+                "er_plan.run_skipped)"
+            ),
+            "compression": (
+                f"ring N={COMPRESS_N}, P=4, top_k k=4 (8 of 17 floats/"
+                "row) vs plain at equal T; wire bytes from "
+                "telemetry.ici_summary over the same static plan the "
+                "collectives execute; gap envelope 2.5x per the "
+                "fused_robust.json convention; sharded-vs-unsharded "
+                "bitwise parity asserted on the compressed cell"
+            ),
+            "overlap": (
+                f"ring N={OVERLAP_N}, P=4, halo_overlap off vs "
+                "double_buffer at matched config, measured iters/sec"
+            ),
+        },
+        "scale": {
+            "n_iterations": SCALE_T,
+            "cells": cells,
+            "per_device_flat_pair": {
+                "cells": ["ring_250k_p4", "ring_1m_p16"],
+                "rows_per_device_each": 62_500,
+                "sharded_bytes_ratio": pair_ratio,
+            },
+        },
+        "er_plan": er_plan,
+        "compression": compression,
+        "overlap": overlap,
+        "gates": {
+            "n1m_ring_completed_sharded": True,
+            "n1m_torus_completed_sharded": True,
+            "per_device_flat_at_matched_rows": bool(
+                0.8 <= pair_ratio <= 1.25
+            ),
+            "ring_ici_bytes_per_device_flat_in_n": True,
+            "er_1m_sparse_plan_built": True,
+            "topk_wire_bytes_ratio": compression["wire_bytes_ratio"],
+            "topk_wire_bytes_halved": bool(
+                compression["wire_bytes_ratio"] <= 0.5
+            ),
+            "topk_gap_within_envelope": bool(
+                compression["gap_ratio"] <= 2.5
+            ),
+            "compressed_models_match_unsharded": compression[
+                "models_match_unsharded"],
+            "overlap_measured": True,
+            "overlap_loses": overlap["overlap_loses"],
+        },
+        "note": (
+            "CPU-container numbers: absolute iters/sec is not chip "
+            "evidence; the load-bearing content is the 1M sharded "
+            "completions, the flat per-device footprint at matched "
+            "rows/device, the <= 50% compressed wire bytes inside the "
+            "2.5x gap envelope, and the honest overlap_loses flag. "
+            "Bitwise guarantees live in tests/test_mesh_scale.py."
+        ),
+    }
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(payload, indent=1, sort_keys=True) + "\n")
+    print(f"wrote {OUT}")
+    write_bench_manifest(
+        OUT,
+        config=_mesh_cfg("ring", 1_000_000, 16),
+        phases=timer,
+    )
+
+
+if __name__ == "__main__":
+    main()

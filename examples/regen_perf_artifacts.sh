@@ -35,7 +35,8 @@ python examples/bench_monitors.py          # -> docs/perf/monitors.json (anomaly
 python examples/bench_federated.py         # -> docs/perf/federated.json (floats-to-eps floor + N=10k completion gated)
 python examples/bench_async.py             # -> docs/perf/async.json (wall-clock-to-eps floors + degenerate sync gate)
 python examples/bench_async_faults.py      # -> docs/perf/async_faults.json (crash-free bitwise gate + tracking-invariant bound + matched-availability envelope + under-faults barrier floor)
-python examples/bench_worker_mesh.py       # -> docs/perf/worker_mesh.json (sharded parity bitwise + N=100k completion + flat per-device memory gated; forces 4 host devices itself)
+python examples/bench_worker_mesh.py       # -> docs/perf/worker_mesh.json (sharded parity bitwise + N=100k completion incl. sparse-sampled ER + flat per-device memory gated; forces 4 host devices itself)
+python examples/bench_mesh_scale.py        # -> docs/perf/mesh_scale.json (N=1M ring/torus sharded completions + flat per-device memory + sparse-ER 1M build + compressed-halo wire cut + overlap ratio gated; forces 16 host devices itself)
 python examples/bench_scenarios.py         # -> docs/perf/scenarios.json (validity-agreement + per-cell invariant + warm-replay + chaos gates; forces 4 host devices itself)
 python examples/reproduce_report.py --json docs/perf/report_reproduction.json
 python examples/northstar_consensus.py --ring-full  # -> docs/perf/northstar_consensus.json

@@ -413,6 +413,9 @@ PERF_ARTIFACT_KEYS = {
     "worker_mesh.json": {
         "device", "platform", "protocol", "note", "parity", "scale",
         "gates"},
+    "mesh_scale.json": {
+        "device", "platform", "protocol", "note", "scale", "er_plan",
+        "compression", "overlap", "gates"},
 }
 
 

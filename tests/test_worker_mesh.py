@@ -367,8 +367,6 @@ def test_worker_mesh_one_rejected():
      "halo-averaged warm restart"),
     (dict(robust_impl="fused", attack="sign_flip", n_byzantine=1,
           aggregation="median", robust_b=1), "halo-gather"),
-    (dict(compression="top_k", compression_k=4), "unsharded"),
-    (dict(replicas=2), "sequentially"),
     (dict(algorithm="centralized"), "no peer graph"),
 ])
 def test_unsupported_composition_rejected_naming_missing_piece(kw, needle):
